@@ -1,0 +1,8 @@
+from .blob import Blob, typed_blob
+from .message import HEADER_SIZE, Message, MsgType
+from .node import Node, Role, is_server, is_worker, role_from_string
+
+__all__ = [
+    "Blob", "typed_blob", "HEADER_SIZE", "Message", "MsgType",
+    "Node", "Role", "is_server", "is_worker", "role_from_string",
+]

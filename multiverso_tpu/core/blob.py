@@ -1,0 +1,85 @@
+"""Shared byte buffer with typed views.
+
+TPU-native equivalent of the reference's ``Blob``
+(ref: include/multiverso/blob.h:13-53, src/blob.cpp:8-46). The reference is
+a ref-counted byte chunk whose copies share memory and whose ``As<T>(i)``
+reinterpret-casts. In Python the natural carrier is a numpy array: numpy
+views already give zero-copy sharing with refcounting (the Allocator/refcount
+machinery of the reference collapses into CPython's GC), and ``as_array``
+gives the reinterpret-cast view. A Blob can also wrap a ``jax.Array``
+lazily — device blobs defer transfer until host bytes are demanded, which is
+what lets table replies stay on-device end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class Blob:
+    __slots__ = ("_data",)
+
+    def __init__(self, data: Any = None, size: int = None):
+        """Wrap existing data (zero-copy for numpy inputs) or allocate.
+
+        ``Blob(size=n)`` allocates ``n`` bytes; ``Blob(array)`` wraps.
+        """
+        if data is None:
+            if size is None:
+                raise ValueError("Blob needs data or size")
+            self._data = np.zeros(size, dtype=np.uint8)
+        elif isinstance(data, Blob):
+            self._data = data._data  # shallow share, like ref copy-ctor
+        elif isinstance(data, np.ndarray):
+            # Zero-copy only holds for contiguous input; a non-contiguous
+            # array is copied here so as_array views stay writable+attached.
+            self._data = np.ascontiguousarray(data)
+        elif isinstance(data, (bytes, bytearray, memoryview)):
+            self._data = np.frombuffer(bytes(data), dtype=np.uint8).copy()
+        else:
+            # jax.Array and anything else exposing __array__ kept as-is;
+            # converted to host bytes only on demand.
+            self._data = data
+
+    @property
+    def data(self) -> Any:
+        return self._data
+
+    def _host(self) -> np.ndarray:
+        if not isinstance(self._data, np.ndarray):
+            self._data = np.asarray(self._data)
+        return self._data
+
+    @property
+    def size(self) -> int:
+        """Size in bytes (the reference's ``size()``)."""
+        arr = self._host()
+        return arr.nbytes
+
+    def count(self, dtype=np.float32) -> int:
+        """Element count under a typed view (the reference's ``size<T>()``)."""
+        return self.size // np.dtype(dtype).itemsize
+
+    def as_array(self, dtype=np.float32) -> np.ndarray:
+        """Typed zero-copy view (the reference's ``As<T>``)."""
+        arr = self._host()
+        if arr.dtype == np.dtype(dtype) and arr.ndim == 1:
+            return arr
+        return arr.reshape(-1).view(dtype)
+
+    def __getitem__(self, i: int) -> int:
+        return int(self._host().reshape(-1).view(np.uint8)[i])
+
+    def copy(self) -> "Blob":
+        """Deep copy (the reference's CopyFrom)."""
+        return Blob(self._host().copy())
+
+    def __len__(self) -> int:
+        return self.size
+
+
+def typed_blob(arr: np.ndarray) -> Blob:
+    """Wrap a typed array as a Blob without byte-flattening."""
+    return Blob(np.ascontiguousarray(arr))

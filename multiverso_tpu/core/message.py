@@ -1,0 +1,124 @@
+"""Wire message: 8-int header + list of payload blobs.
+
+TPU-native equivalent of the reference's ``Message``
+(ref: include/multiverso/message.h:13-66). Header layout and ``MsgType``
+values are preserved exactly (src, dst, type, table_id, msg_id in
+header[0..4]; requests positive, replies negative, control types >32) so the
+routing rules in the communicator (ref: src/communicator.cpp:93-105) carry
+over and a future cross-language transport can interoperate.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+import numpy as np
+
+from .blob import Blob
+
+
+class MsgType(enum.IntEnum):
+    """ref: include/multiverso/message.h:13-24."""
+    Default = 0
+    Request_Get = 1
+    Request_Add = 2
+    Reply_Get = -1
+    Reply_Add = -2
+    Server_Finish_Train = 31
+    Control_Barrier = 33
+    Control_Reply_Barrier = -33
+    Control_Register = 34
+    Control_Reply_Register = -34
+
+HEADER_SIZE = 8  # ints
+
+
+class Message:
+    __slots__ = ("header", "data")
+
+    def __init__(self, src: int = -1, dst: int = -1,
+                 msg_type: MsgType = MsgType.Default,
+                 table_id: int = -1, msg_id: int = -1):
+        self.header = [0] * HEADER_SIZE
+        self.header[0] = src
+        self.header[1] = dst
+        self.header[2] = int(msg_type)
+        self.header[3] = table_id
+        self.header[4] = msg_id
+        self.data: List[Blob] = []
+
+    # -- header accessors (ref: message.h:28-38) --
+    @property
+    def src(self) -> int:
+        return self.header[0]
+
+    @src.setter
+    def src(self, v: int) -> None:
+        self.header[0] = v
+
+    @property
+    def dst(self) -> int:
+        return self.header[1]
+
+    @dst.setter
+    def dst(self, v: int) -> None:
+        self.header[1] = v
+
+    @property
+    def type(self) -> MsgType:
+        return MsgType(self.header[2])
+
+    @type.setter
+    def type(self, v: MsgType) -> None:
+        self.header[2] = int(v)
+
+    @property
+    def table_id(self) -> int:
+        return self.header[3]
+
+    @table_id.setter
+    def table_id(self, v: int) -> None:
+        self.header[3] = v
+
+    @property
+    def msg_id(self) -> int:
+        return self.header[4]
+
+    @msg_id.setter
+    def msg_id(self, v: int) -> None:
+        self.header[4] = v
+
+    def push(self, blob) -> None:
+        if not isinstance(blob, Blob):
+            blob = Blob(np.ascontiguousarray(blob))
+        self.data.append(blob)
+
+    def size(self) -> int:
+        return len(self.data)
+
+    def create_reply_message(self) -> "Message":
+        """Reply with src/dst swapped and type negated (ref: message.h:51-59)."""
+        reply = Message(src=self.dst, dst=self.src,
+                        msg_type=MsgType(-self.header[2]),
+                        table_id=self.table_id, msg_id=self.msg_id)
+        return reply
+
+    def __repr__(self) -> str:
+        return (f"Message(src={self.src}, dst={self.dst}, type={self.type.name}, "
+                f"table={self.table_id}, msg_id={self.msg_id}, blobs={len(self.data)})")
+
+
+def is_server_bound(msg_type: int) -> bool:
+    """Request types route to the server actor (ref: communicator.cpp:93-105)."""
+    return 0 < msg_type < 32
+
+
+def is_worker_bound(msg_type: int) -> bool:
+    """Reply types route to the worker actor."""
+    return -32 < msg_type < 0
+
+
+def is_controller_bound(msg_type: int) -> bool:
+    """Control requests route to the controller actor."""
+    return msg_type > 32

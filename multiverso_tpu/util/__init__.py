@@ -1,0 +1,12 @@
+from . import configure, log
+from .async_buffer import ASyncBuffer
+from .dashboard import Dashboard, Monitor, monitor
+from .mt_queue import MtQueue
+from .quantization import OneBitFilter, SparseFilter
+from .timer import Timer
+from .waiter import Waiter
+
+__all__ = [
+    "configure", "log", "ASyncBuffer", "Dashboard", "Monitor", "monitor",
+    "MtQueue", "OneBitFilter", "SparseFilter", "Timer", "Waiter",
+]

@@ -1,0 +1,58 @@
+"""Double-buffer prefetcher.
+
+TPU-native equivalent of the reference's ``ASyncBuffer``
+(ref: include/multiverso/util/async_buffer.h:11-116): a background thread
+fills the idle buffer via a user-provided fill function while the caller
+consumes the ready one; ``get()`` waits for the in-flight fill then
+immediately kicks off the next prefetch. This is the host-side overlap
+primitive used by the data pipelines (the reference apps' ``-is_pipeline``
+mode); on TPU it composes with jax async dispatch so host fill overlaps
+device compute.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class ASyncBuffer(Generic[T]):
+    def __init__(self, buffer0: T, buffer1: T, fill: Callable[[T], None]):
+        self._buffers = [buffer0, buffer1]
+        self._fill = fill
+        self._ready_idx = 0
+        self._pending: "threading.Thread | None" = None
+        self._fill_error: "BaseException | None" = None
+        self._stopped = False
+        self._prefetch(0)
+
+    def _prefetch(self, idx: int) -> None:
+        def run() -> None:
+            try:
+                self._fill(self._buffers[idx])
+            except BaseException as exc:  # re-raised in get()
+                self._fill_error = exc
+        self._pending = threading.Thread(target=run, daemon=True)
+        self._pending.start()
+        self._pending_idx = idx
+
+    def get(self) -> T:
+        """Wait for the in-flight fill, return that buffer, prefetch the other."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._fill_error is not None:
+            err, self._fill_error = self._fill_error, None
+            raise err
+        ready = self._pending_idx
+        if not self._stopped:
+            self._prefetch(1 - ready)
+        return self._buffers[ready]
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None

@@ -1,0 +1,154 @@
+"""Typed flag/configuration registry.
+
+TPU-native re-design of the reference's gflags-like system
+(ref: include/multiverso/util/configure.h:11-114, src/util/configure.cpp:9-54).
+Semantics preserved:
+
+- flags are registered with a name, default value and description;
+- ``parse_cmd_flags(argv)`` consumes ``-key=value`` entries (leaving every
+  other entry in place, compacting the list) and returns the remaining argv;
+- values are readable/writable at any time (``get_flag`` / ``set_flag``,
+  the reference's ``MV_CONFIG_<name>`` / ``MV_SetFlag``).
+
+Unlike the reference there is one registry keyed by name (the reference keeps
+one static registry per C++ type); type is enforced by the registered default.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "description")
+
+    def __init__(self, name: str, default: Any, description: str = ""):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type(default)
+        self.description = description
+
+
+class FlagRegister:
+    """Process-wide flag registry (singleton)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+
+    @classmethod
+    def get(cls) -> "FlagRegister":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = FlagRegister()
+            return cls._instance
+
+    def define(self, name: str, default: Any, description: str = "") -> None:
+        if name in self._flags:
+            # Re-definition keeps the current value (module reloads in tests).
+            return
+        self._flags[name] = _Flag(name, default, description)
+
+    def has(self, name: str) -> bool:
+        return name in self._flags
+
+    def get_value(self, name: str) -> Any:
+        if name not in self._flags:
+            raise KeyError(f"unknown flag: {name}")
+        return self._flags[name].value
+
+    def set_value(self, name: str, value: Any) -> None:
+        if name not in self._flags:
+            # Mirrors reference behavior: SetCMDFlag on an unregistered flag
+            # registers it implicitly (string-typed if value is a string).
+            self._flags[name] = _Flag(name, value)
+            return
+        flag = self._flags[name]
+        try:
+            flag.value = _coerce(value, flag.type)
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"bad value for flag -{name} "
+                f"(expected {flag.type.__name__}): {value!r}") from exc
+
+    def reset(self) -> None:
+        for flag in self._flags.values():
+            flag.value = flag.default
+
+    def all_flags(self) -> Dict[str, Any]:
+        return {k: f.value for k, f in self._flags.items()}
+
+
+def _coerce(value: Any, typ: type) -> Any:
+    if isinstance(value, typ) and not (typ is int and isinstance(value, bool)):
+        return value
+    if typ is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("true", "1", "yes", "on")
+        return bool(value)
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return str(value)
+
+
+def define_int(name: str, default: int, description: str = "") -> None:
+    FlagRegister.get().define(name, int(default), description)
+
+
+def define_bool(name: str, default: bool, description: str = "") -> None:
+    FlagRegister.get().define(name, bool(default), description)
+
+
+def define_string(name: str, default: str, description: str = "") -> None:
+    FlagRegister.get().define(name, str(default), description)
+
+
+def define_double(name: str, default: float, description: str = "") -> None:
+    FlagRegister.get().define(name, float(default), description)
+
+
+def get_flag(name: str, default: Any = None) -> Any:
+    reg = FlagRegister.get()
+    if not reg.has(name):
+        if default is not None:
+            return default
+        raise KeyError(f"unknown flag: {name}")
+    return reg.get_value(name)
+
+
+def set_flag(name: str, value: Any) -> None:
+    FlagRegister.get().set_value(name, value)
+
+
+def reset_flags() -> None:
+    FlagRegister.get().reset()
+
+
+def parse_cmd_flags(argv: List[str]) -> List[str]:
+    """Consume ``-key=value`` entries matching registered flags.
+
+    Returns the compacted argv with consumed entries removed — the same
+    contract as the reference's ``ParseCMDFlags`` (configure.cpp:19-53):
+    only entries that match a registered flag are consumed; everything else
+    (including unknown ``-key=value`` pairs) is left for downstream parsers.
+    """
+    if argv is None:
+        return []
+    remaining: List[str] = []
+    reg = FlagRegister.get()
+    for arg in argv:
+        if isinstance(arg, bytes):
+            arg = arg.decode()
+        if arg.startswith("-") and "=" in arg:
+            key, _, value = arg.lstrip("-").partition("=")
+            if reg.has(key):
+                reg.set_value(key, value)
+                continue
+        remaining.append(arg)
+    return remaining

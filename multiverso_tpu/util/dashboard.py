@@ -1,0 +1,124 @@
+"""Named performance counters (tracing/profiling subsystem).
+
+TPU-native equivalent of the reference's ``Dashboard``/``Monitor``
+(ref: include/multiverso/dashboard.h:16-74, src/dashboard.cpp:14-49): global
+registry of named monitors, each accumulating call count and elapsed ms;
+``Dashboard.display()`` dumps all. The MONITOR_BEGIN/END macro pair becomes a
+context manager (``with monitor("name"):``); on TPU, ``jax.profiler`` traces
+can be layered on via ``trace=True`` which opens a profiler ``TraceAnnotation``
+so monitored regions show up in xprof.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Monitor:
+    def __init__(self, name: str):
+        self.name = name
+        self._count = 0
+        self._elapsed_ms = 0.0
+        self._local = threading.local()  # per-thread begin time
+        self._lock = threading.Lock()
+
+    def begin(self) -> None:
+        self._local.begin = time.perf_counter()
+
+    def end(self) -> None:
+        begin = getattr(self._local, "begin", None)
+        if begin is None:
+            return
+        elapsed = (time.perf_counter() - begin) * 1e3
+        with self._lock:
+            self._count += 1
+            self._elapsed_ms += elapsed
+        self._local.begin = None
+
+    def add(self, elapsed_ms: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._elapsed_ms += elapsed_ms
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def elapse(self) -> float:
+        return self._elapsed_ms
+
+    @property
+    def average(self) -> float:
+        return self._elapsed_ms / self._count if self._count else 0.0
+
+    def __str__(self) -> str:
+        return (f"[{self.name}] count = {self._count} "
+                f"elapse = {self._elapsed_ms:.2f}ms "
+                f"average = {self.average:.3f}ms")
+
+
+class Dashboard:
+    _monitors: Dict[str, Monitor] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls, name: str) -> Monitor:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+            if mon is None:
+                mon = Monitor(name)
+                cls._monitors[name] = mon
+            return mon
+
+    @classmethod
+    def add_monitor(cls, monitor: Monitor) -> None:
+        with cls._lock:
+            cls._monitors[monitor.name] = monitor
+
+    @classmethod
+    def watch(cls, name: str) -> str:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+            return str(mon) if mon else f"[{name}] <unregistered>"
+
+    @classmethod
+    def display(cls) -> str:
+        with cls._lock:
+            lines = [str(m) for m in cls._monitors.values()]
+        report = "\n".join(lines)
+        return report
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._monitors.clear()
+
+
+class monitor:
+    """Context manager replacing MONITOR_BEGIN/END macro pair.
+
+    With ``trace=True`` also emits a jax.profiler TraceAnnotation so the
+    region is visible in xprof traces captured on TPU.
+    """
+
+    def __init__(self, name: str, trace: bool = False):
+        self._monitor = Dashboard.get(name)
+        self._trace_ctx = None
+        if trace:
+            import jax.profiler
+            self._trace_ctx = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self) -> Monitor:
+        if self._trace_ctx is not None:
+            self._trace_ctx.__enter__()
+        self._monitor.begin()
+        return self._monitor
+
+    def __exit__(self, *exc) -> None:
+        self._monitor.end()
+        if self._trace_ctx is not None:
+            self._trace_ctx.__exit__(*exc)
+        return None

@@ -1,0 +1,76 @@
+"""Blocking multi-producer/multi-consumer queue with explicit exit.
+
+TPU-native equivalent of the reference's ``MtQueue``
+(ref: include/multiverso/util/mt_queue.h:19-147). ``pop`` blocks until an
+item arrives or ``exit()`` is called; after exit, ``pop``/``try_pop`` return
+``None``/False immediately. Built on a deque + condition variable, like the
+reference's mutex+condvar design.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Generic, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class MtQueue(Generic[T]):
+    def __init__(self) -> None:
+        self._buffer: Deque[T] = collections.deque()
+        self._mutex = threading.Lock()
+        self._cond = threading.Condition(self._mutex)
+        self._exit = False
+
+    def push(self, item: T) -> None:
+        with self._cond:
+            self._buffer.append(item)
+            self._cond.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[T]:
+        """Block until an item is available; None once exited (or timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._buffer and not self._exit:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                if not self._cond.wait(timeout=remaining):
+                    return None
+            if self._buffer:
+                return self._buffer.popleft()
+            return None
+
+    def try_pop(self) -> Tuple[bool, Optional[T]]:
+        with self._mutex:
+            if self._buffer:
+                return True, self._buffer.popleft()
+            return False, None
+
+    def front(self) -> Optional[T]:
+        """Block until an item is available and peek it without removing."""
+        with self._cond:
+            while not self._buffer and not self._exit:
+                self._cond.wait()
+            return self._buffer[0] if self._buffer else None
+
+    def empty(self) -> bool:
+        with self._mutex:
+            return not self._buffer
+
+    def size(self) -> int:
+        with self._mutex:
+            return len(self._buffer)
+
+    def exit(self) -> None:
+        with self._cond:
+            self._exit = True
+            self._cond.notify_all()
+
+    @property
+    def alive(self) -> bool:
+        with self._mutex:
+            return not self._exit

@@ -1,0 +1,101 @@
+"""Wire compression filters for sparse table traffic.
+
+TPU-native equivalent of the reference's ``SparseFilter``
+(ref: include/multiverso/util/quantization_util.h:25-158). Per payload blob:
+if more than half of the values are within ``clip_value`` of zero, the blob
+is rewritten as (index, value) pairs; a side "size record" carries the
+original element count, with -1 meaning "left uncompressed". ``filter_in``
+compresses an outgoing list of arrays, ``filter_out`` reverses it.
+
+Vectorized with numpy (the reference loops element-wise); on-device
+equivalents for ICI paths live in ``multiverso_tpu.parallel.collectives``
+(top-k / threshold sparsification before a ragged all-to-all).
+
+The reference's ``OneBitsFilter`` is an empty stub
+(quantization_util.h:160-161); here ``OneBitFilter`` implements the standard
+1-bit SGD scheme (sign + per-blob scale, error feedback left to the caller)
+as the functional completion of that stub.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+UNCOMPRESSED = -1
+
+
+class SparseFilter:
+    def __init__(self, clip_value: float = 0.0):
+        self._clip = float(clip_value)
+
+    def filter_in(self, blobs: Sequence[np.ndarray]) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Compress each blob independently.
+
+        Returns (compressed_blobs, size_record) where size_record[i] is the
+        original element count if blob i was compressed, else UNCOMPRESSED.
+        """
+        out: List[np.ndarray] = []
+        sizes = np.empty(len(blobs), dtype=np.int64)
+        for i, blob in enumerate(blobs):
+            arr = np.asarray(blob)
+            flat = arr.reshape(-1)
+            nonzero = np.abs(flat) > self._clip
+            n_keep = int(np.count_nonzero(nonzero))
+            if flat.size > 0 and n_keep * 2 < flat.size:
+                idx = np.nonzero(nonzero)[0]
+                vals = flat[idx]
+                # float64 pairs: holds indices exactly up to 2^53 and float32
+                # values exactly; halves the wire size whenever <50% survive.
+                pairs = np.empty(idx.size * 2, dtype=np.float64)
+                pairs[0::2] = idx
+                pairs[1::2] = vals
+                out.append(pairs)
+                sizes[i] = flat.size
+            else:
+                out.append(flat)
+                sizes[i] = UNCOMPRESSED
+        return out, sizes
+
+    def filter_out(self, blobs: Sequence[np.ndarray], size_record: np.ndarray,
+                   dtype=np.float32) -> List[np.ndarray]:
+        """Reverse ``filter_in``."""
+        out: List[np.ndarray] = []
+        for blob, size in zip(blobs, size_record):
+            if size == UNCOMPRESSED:
+                out.append(np.asarray(blob, dtype=dtype))
+                continue
+            pairs = np.asarray(blob, dtype=np.float64)
+            full = np.zeros(int(size), dtype=dtype)
+            idx = pairs[0::2].astype(np.int64)
+            full[idx] = pairs[1::2].astype(dtype)
+            out.append(full)
+        return out
+
+
+class OneBitFilter:
+    """1-bit quantization: sign bitmap + mean-magnitude scales per sign.
+
+    Functional completion of the reference's empty ``OneBitsFilter`` stub
+    (quantization_util.h:160-161). Encoding per blob: (packed sign bits,
+    positive mean, negative mean, original size). Decoding reconstructs
+    each element as the mean magnitude of its sign class. Error-feedback
+    residual is returned to the caller to accumulate locally.
+    """
+
+    def encode(self, arr: np.ndarray):
+        flat = np.asarray(arr, dtype=np.float32).reshape(-1)
+        pos = flat > 0
+        pos_mean = float(flat[pos].mean()) if pos.any() else 0.0
+        neg = ~pos
+        neg_mean = float(flat[neg].mean()) if neg.any() else 0.0
+        bits = np.packbits(pos.astype(np.uint8))
+        decoded = np.where(pos, pos_mean, neg_mean).astype(np.float32)
+        residual = flat - decoded
+        return (bits, pos_mean, neg_mean, flat.size), residual
+
+    def decode(self, encoded) -> np.ndarray:
+        bits, pos_mean, neg_mean, size = encoded
+        pos = np.unpackbits(bits)[:size].astype(bool)
+        return np.where(pos, np.float32(pos_mean), np.float32(neg_mean))

@@ -1,0 +1,40 @@
+"""Countdown latch used by async table requests.
+
+TPU-native equivalent of the reference's ``Waiter``
+(ref: include/multiverso/util/waiter.h:9-33): ``wait()`` blocks until
+``notify()`` has been called ``num_wait`` times; ``reset(n)`` re-arms.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Waiter:
+    def __init__(self, num_wait: int = 1):
+        self._mutex = threading.Lock()
+        self._cond = threading.Condition(self._mutex)
+        self._num_wait = num_wait
+
+    def wait(self, timeout=None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._num_wait > 0:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                if not self._cond.wait(timeout=remaining):
+                    return False
+            return True
+
+    def notify(self) -> None:
+        with self._cond:
+            self._num_wait -= 1
+            if self._num_wait <= 0:
+                self._cond.notify_all()
+
+    def reset(self, num_wait: int) -> None:
+        with self._cond:
+            self._num_wait = num_wait

@@ -1,0 +1,244 @@
+"""Utility-layer unit tests (mirrors reference Test/unittests tier 1,
+ref: Test/unittests/test_blob.cpp, test_message.cpp, test_node.cpp plus
+flag/queue/waiter/dashboard coverage)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.core import Blob, Message, MsgType, Node, Role, is_server, is_worker
+from multiverso_tpu.util import (ASyncBuffer, Dashboard, MtQueue, OneBitFilter,
+                                 SparseFilter, Timer, Waiter, configure, monitor)
+from multiverso_tpu.util.log import CHECK, FatalError
+
+
+class TestBlob:
+    def test_alloc_and_view(self):
+        b = Blob(size=12)
+        assert b.size == 12
+        f = b.as_array(np.float32)
+        assert f.size == 3
+        f[:] = [1.0, 2.0, 3.0]
+        assert b.as_array(np.float32)[1] == 2.0
+
+    def test_wrap_shares_memory(self):
+        arr = np.arange(4, dtype=np.float32)
+        b = Blob(arr)
+        b2 = Blob(b)  # shallow copy shares storage like ref copy-ctor
+        b2.as_array(np.float32)[0] = 42.0
+        assert arr[0] == 42.0
+
+    def test_copy_is_deep(self):
+        arr = np.arange(4, dtype=np.int32)
+        b = Blob(arr).copy()
+        b.as_array(np.int32)[0] = 9
+        assert arr[0] == 0
+
+    def test_typed_count(self):
+        b = Blob(np.zeros(10, dtype=np.float64))
+        assert b.count(np.float64) == 10
+        assert b.count(np.float32) == 20
+
+
+class TestMessage:
+    def test_header_roundtrip(self):
+        m = Message(src=3, dst=5, msg_type=MsgType.Request_Add, table_id=2, msg_id=7)
+        assert (m.src, m.dst, m.type, m.table_id, m.msg_id) == \
+            (3, 5, MsgType.Request_Add, 2, 7)
+
+    def test_reply_flips(self):
+        m = Message(src=3, dst=5, msg_type=MsgType.Request_Get, table_id=1, msg_id=9)
+        r = m.create_reply_message()
+        assert r.src == 5 and r.dst == 3
+        assert r.type == MsgType.Reply_Get
+        assert r.table_id == 1 and r.msg_id == 9
+
+    def test_payload(self):
+        m = Message()
+        m.push(np.arange(3, dtype=np.float32))
+        m.push(np.arange(5, dtype=np.int32))
+        assert m.size() == 2
+        assert m.data[0].count(np.float32) == 3
+
+
+class TestNode:
+    def test_roles(self):
+        assert is_worker(Role.WORKER) and not is_server(Role.WORKER)
+        assert is_server(Role.SERVER) and not is_worker(Role.SERVER)
+        assert is_worker(Role.ALL) and is_server(Role.ALL)
+        assert not is_worker(Role.NONE) and not is_server(Role.NONE)
+
+    def test_default_node(self):
+        n = Node()
+        assert n.rank == -1 and n.role == Role.ALL
+
+
+class TestConfigure:
+    def test_parse_cmd_flags(self):
+        configure.define_int("test_port", 9999)
+        configure.define_bool("test_sync", False)
+        configure.define_string("test_name", "x")
+        argv = ["prog", "-test_port=1234", "keepme", "-test_sync=true",
+                "-test_name=hello"]
+        rest = configure.parse_cmd_flags(argv)
+        assert rest == ["prog", "keepme"]
+        assert configure.get_flag("test_port") == 1234
+        assert configure.get_flag("test_sync") is True
+        assert configure.get_flag("test_name") == "hello"
+
+    def test_set_flag_coerces(self):
+        configure.define_double("test_lr", 0.1)
+        configure.set_flag("test_lr", "0.5")
+        assert configure.get_flag("test_lr") == 0.5
+
+    def test_unknown_flag_left_in_argv(self):
+        # Reference parity: ParseCMDFlags only consumes registered flags
+        # (configure.cpp:19-53); unknown entries stay for downstream parsers.
+        rest = configure.parse_cmd_flags(["-brandnew=abc"])
+        assert rest == ["-brandnew=abc"]
+        # Programmatic set_flag (the reference's SetCMDFlag/MV_SetFlag)
+        # still registers implicitly.
+        configure.set_flag("brandnew", "abc")
+        assert configure.get_flag("brandnew") == "abc"
+
+    def test_bad_value_names_flag(self):
+        configure.define_int("test_badval", 1)
+        with pytest.raises(ValueError, match="test_badval"):
+            configure.parse_cmd_flags(["-test_badval=abc"])
+
+
+class TestMtQueue:
+    def test_fifo(self):
+        q = MtQueue()
+        for i in range(5):
+            q.push(i)
+        assert q.size() == 5
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+
+    def test_blocking_pop(self):
+        q = MtQueue()
+        result = []
+
+        def consumer():
+            result.append(q.pop())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.push("item")
+        t.join(timeout=2)
+        assert result == ["item"]
+
+    def test_exit_unblocks(self):
+        q = MtQueue()
+        result = []
+
+        def consumer():
+            result.append(q.pop())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        q.exit()
+        t.join(timeout=2)
+        assert result == [None]
+        ok, _ = q.try_pop()
+        assert not ok
+
+
+class TestWaiter:
+    def test_countdown(self):
+        w = Waiter(2)
+        done = []
+
+        def waiter_thread():
+            w.wait()
+            done.append(True)
+
+        t = threading.Thread(target=waiter_thread)
+        t.start()
+        w.notify()
+        time.sleep(0.02)
+        assert not done
+        w.notify()
+        t.join(timeout=2)
+        assert done
+
+    def test_reset(self):
+        w = Waiter(1)
+        w.notify()
+        assert w.wait(timeout=0.1)
+        w.reset(1)
+        assert not w.wait(timeout=0.05)
+
+
+class TestAsyncBuffer:
+    def test_prefetch_sequence(self):
+        counter = {"n": 0}
+
+        def fill(buf):
+            counter["n"] += 1
+            buf[0] = counter["n"]
+
+        ab = ASyncBuffer([0], [0], fill)
+        first = ab.get()
+        assert first[0] == 1
+        second = ab.get()
+        assert second[0] == 2
+        ab.stop()
+
+
+class TestSparseFilter:
+    def test_compress_roundtrip(self):
+        f = SparseFilter(clip_value=0.0)
+        dense = np.zeros(100, dtype=np.float32)
+        dense[[3, 50, 99]] = [1.5, -2.0, 3.0]
+        blobs, sizes = f.filter_in([dense])
+        assert sizes[0] == 100
+        assert blobs[0].size == 6  # 3 pairs
+        out = f.filter_out(blobs, sizes)
+        np.testing.assert_array_equal(out[0], dense)
+
+    def test_dense_passthrough(self):
+        f = SparseFilter()
+        dense = np.arange(1, 11, dtype=np.float32)
+        blobs, sizes = f.filter_in([dense])
+        assert sizes[0] == -1
+        out = f.filter_out(blobs, sizes)
+        np.testing.assert_array_equal(out[0], dense)
+
+    def test_one_bit(self):
+        f = OneBitFilter()
+        arr = np.array([1.0, 2.0, -1.0, -3.0], dtype=np.float32)
+        enc, residual = f.encode(arr)
+        dec = f.decode(enc)
+        np.testing.assert_allclose(dec, [1.5, 1.5, -2.0, -2.0])
+        np.testing.assert_allclose(arr - dec, residual)
+
+
+class TestDashboardAndTimer:
+    def test_monitor_counts(self):
+        Dashboard.reset()
+        with monitor("unit_test_region"):
+            time.sleep(0.01)
+        with monitor("unit_test_region"):
+            pass
+        mon = Dashboard.get("unit_test_region")
+        assert mon.count == 2
+        assert mon.elapse >= 10.0
+        assert "unit_test_region" in Dashboard.display()
+
+    def test_timer(self):
+        t = Timer()
+        time.sleep(0.01)
+        assert t.elapse() >= 9.0
+        t.start()
+        assert t.elapse() < 9.0
+
+
+class TestCheck:
+    def test_check_raises(self):
+        with pytest.raises(FatalError):
+            CHECK(False, "boom")
+        CHECK(True)
