@@ -1,0 +1,67 @@
+"""multiverso_tpu: a TPU-native parameter-server framework.
+
+Brand-new implementation of the capabilities of Microsoft Multiverso
+(the DMTK parameter server) designed for JAX/XLA on TPU. Distributed tables
+live as sharded ``jax.Array``s in HBM; server-side optimizers are
+jit-compiled donated-buffer updates; model-average mode maps to
+``lax.psum`` over the device mesh.
+
+Public API mirrors the reference's ``MV_*`` surface
+(ref: include/multiverso/multiverso.h:9-65).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .runtime.zoo import Zoo, current_zoo, set_default_zoo, set_thread_zoo
+from .util.configure import set_flag as _set_flag
+
+__version__ = "0.1.0"
+
+
+def init(argv: Optional[List[str]] = None) -> List[str]:
+    """MV_Init (ref: src/multiverso.cpp:11-14). Returns remaining argv."""
+    zoo = Zoo()
+    set_default_zoo(zoo)
+    return zoo.start(argv)
+
+
+def shutdown(finalize_net: bool = True) -> None:
+    """MV_ShutDown (ref: src/multiverso.cpp:20-23)."""
+    current_zoo().stop(finalize_net)
+    set_default_zoo(None)
+
+
+def barrier() -> None:
+    """MV_Barrier (ref: src/multiverso.cpp:16-18)."""
+    current_zoo().barrier()
+
+
+def rank() -> int:
+    return current_zoo().rank
+
+
+def size() -> int:
+    return current_zoo().size
+
+
+def num_workers() -> int:
+    return current_zoo().num_workers
+
+
+def num_servers() -> int:
+    return current_zoo().num_servers
+
+
+def worker_id() -> int:
+    return current_zoo().worker_id
+
+
+def server_id() -> int:
+    return current_zoo().server_id
+
+
+def set_flag(name: str, value) -> None:
+    """MV_SetFlag (ref: src/multiverso.cpp:48-51)."""
+    _set_flag(name, value)

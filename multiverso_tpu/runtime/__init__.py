@@ -1,0 +1,11 @@
+"""Runtime: actors, transport, zoo, virtual clusters.
+
+TPU-native re-design of the reference's actor system
+(ref: src/zoo.cpp, src/actor.cpp, src/communicator.cpp, src/controller.cpp,
+src/worker.cpp, src/server.cpp).
+"""
+
+from .actor import Actor  # noqa: F401
+from .cluster import LocalCluster  # noqa: F401
+from .net import LocalFabric, LocalNet, NetInterface  # noqa: F401
+from .zoo import Zoo, current_zoo, set_default_zoo, set_thread_zoo  # noqa: F401

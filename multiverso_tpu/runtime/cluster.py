@@ -1,0 +1,94 @@
+"""In-process virtual cluster for tests and single-host multi-rank runs.
+
+The reference tests distributed behavior by actually launching
+``mpirun -np 4`` (ref: deploy/docker/Dockerfile:100-110) and has a
+degenerate single-process mode where one rank is both worker and server
+(ref: Test/unittests/multiverso_env.h:9-31). On TPU a single JAX process
+already drives every local chip, so the natural multi-rank unit is a
+*thread* per virtual rank over a shared ``LocalFabric`` — same actor stack,
+same registration/barrier protocol, no MPI. Real multi-host deployments run
+one Zoo per host over the DCN transport instead.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from .net import LocalFabric
+from .zoo import ClusterAborted, Zoo, set_thread_zoo
+
+
+class LocalCluster:
+    """Run ``fn(rank)`` on ``n`` virtual ranks, each with its own Zoo."""
+
+    def __init__(self, n: int, argv: Optional[List[str]] = None,
+                 roles: Optional[List[str]] = None):
+        """``roles`` optionally gives one -ps_role value per rank (the flag
+        registry is process-global, so heterogeneous roles are passed here
+        instead of via argv)."""
+        self.n = n
+        self.argv = list(argv or [])
+        if roles is not None and len(roles) != n:
+            raise ValueError("roles must have one entry per rank")
+        self.roles = roles
+        self.timeout = 120.0
+
+    def run(self, fn: Callable[[int], Any]) -> List[Any]:
+        fabric = LocalFabric(self.n)
+        results: List[Any] = [None] * self.n
+        errors: List[Optional[BaseException]] = [None] * self.n
+        zoos: List[Optional[Zoo]] = [None] * self.n
+
+        def abort_all() -> None:
+            for z in zoos:
+                if z is not None:
+                    z.abort()
+
+        def rank_main(rank: int) -> None:
+            zoo = Zoo()
+            zoos[rank] = zoo
+            set_thread_zoo(zoo)
+            started = False
+            try:
+                zoo.start(list(self.argv), net=fabric.endpoint(rank),
+                          role=self.roles[rank] if self.roles else None)
+                started = True
+                results[rank] = fn(rank)
+            except BaseException as exc:  # noqa: BLE001 - reported to caller
+                errors[rank] = exc
+                # Unblock every sibling barrier/wait — a failed rank would
+                # otherwise mispair barriers and hang the whole cluster.
+                abort_all()
+            finally:
+                try:
+                    if started:
+                        zoo.stop()
+                except BaseException as exc:  # noqa: BLE001
+                    if errors[rank] is None:
+                        errors[rank] = exc
+                finally:
+                    set_thread_zoo(None)
+
+        threads = [threading.Thread(target=rank_main, args=(r,),
+                                    name=f"mv-rank-{r}", daemon=True)
+                   for r in range(self.n)]
+        for t in threads:
+            t.start()
+        hung = []
+        for t in threads:
+            t.join(timeout=self.timeout)
+            if t.is_alive():
+                hung.append(t.name)
+        # Report a primary error over collateral ClusterAborted fallout.
+        primary = [e for e in errors
+                   if e is not None and not isinstance(e, ClusterAborted)]
+        if primary:
+            raise primary[0]
+        for exc in errors:
+            if exc is not None:
+                raise exc
+        if hung:
+            abort_all()
+            raise TimeoutError(f"virtual rank threads hung: {hung}")
+        return results

@@ -1,0 +1,91 @@
+"""Bridge between local actors and the wire.
+
+TPU-native equivalent of the reference's ``Communicator``
+(ref: include/multiverso/communicator.h:11-28, src/communicator.cpp:31-107).
+The in-process transport is thread-safe (THREAD_MULTIPLE in reference
+terms), so this uses the reference's ZMQ shape: the actor thread handles
+outbound traffic while a separate receive thread drains the net endpoint
+(ref: src/communicator.cpp:42-48,77-91). Inbound and loop-back messages are
+routed to the right local actor by message type — requests to the server,
+replies to the worker, control requests to the controller, control replies
+to the Zoo mailbox (ref: src/communicator.cpp:13-29,93-105).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core.message import (Message, is_controller_bound, is_server_bound,
+                            is_worker_bound)
+from ..util import log
+from . import actor as actors
+from .actor import Actor
+
+
+class Communicator(Actor):
+    def __init__(self, zoo) -> None:
+        super().__init__(actors.COMMUNICATOR, zoo)
+        self._net = zoo.net
+        self._recv_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        super().start()
+        self._recv_thread = threading.Thread(
+            target=self._recv_main,
+            name=f"mv-comm-recv-r{self._zoo.rank}", daemon=True)
+        self._recv_thread.start()
+
+    def stop(self, finalize_net: bool = True) -> None:
+        if finalize_net:
+            self._net.finalize()
+        else:
+            self._net.interrupt_recv()
+        if self._recv_thread is not None:
+            self._recv_thread.join(timeout=30)
+        super().stop()
+
+    # Outbound path: actor mailbox -> wire (or loop back locally).
+    def _main(self) -> None:
+        while True:
+            msg = self.mailbox.pop()
+            if msg is None:
+                break
+            try:
+                self._process_message(msg)
+            except Exception:  # noqa: BLE001
+                log.error("communicator: send path raised")
+                import traceback
+                traceback.print_exc()
+
+    def _process_message(self, msg: Message) -> None:
+        if msg.dst != self._zoo.rank:
+            self._net.send(msg)
+        else:
+            self._local_forward(msg)
+
+    # Inbound path: wire -> local actor mailboxes
+    # (ref: src/communicator.cpp:77-91).
+    def _recv_main(self) -> None:
+        while True:
+            msg = self._net.recv()
+            if msg is None:
+                break
+            try:
+                self._local_forward(msg)
+            except Exception:  # noqa: BLE001
+                log.error("communicator: recv routing raised")
+                import traceback
+                traceback.print_exc()
+
+    # Routing rule (ref: src/communicator.cpp:13-29).
+    def _local_forward(self, msg: Message) -> None:
+        msg_type = int(msg.header[2])
+        if is_server_bound(msg_type):
+            self._zoo.route(actors.SERVER, msg)
+        elif is_worker_bound(msg_type):
+            self._zoo.route(actors.WORKER, msg)
+        elif is_controller_bound(msg_type):
+            self._zoo.route(actors.CONTROLLER, msg)
+        else:
+            self._zoo.mailbox.push(msg)

@@ -1,0 +1,173 @@
+"""Server actor: owns table shards and applies updates.
+
+TPU-native equivalent of the reference's ``Server``/``SyncServer``
+(ref: include/multiverso/server.h:13-24, src/server.cpp:23-233). The async
+server invokes table logic directly and replies; the BSP ``SyncServer``
+gates requests behind per-worker vector clocks so that every worker's i-th
+Get observes exactly the state after all workers' j-th Adds — the same
+contract as the reference (ref: src/server.cpp:60-66). The table storage the
+server fronts is a sharded ``jax.Array`` in device HBM; the per-message work
+here is host-side control only, with the arithmetic jit-dispatched.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, List
+
+from ..core.message import Message, MsgType
+from ..util import log
+from ..util.configure import get_flag
+from ..util.dashboard import monitor
+from . import actor as actors
+from .actor import Actor
+
+_INF = float("inf")
+
+
+class Server(Actor):
+    def __init__(self, zoo) -> None:
+        super().__init__(actors.SERVER, zoo)
+        self._store: List = []  # registered ServerTables, indexed by table id
+        self.register_handler(MsgType.Request_Get, self._process_get)
+        self.register_handler(MsgType.Request_Add, self._process_add)
+
+    @staticmethod
+    def get_server(zoo) -> "Server":
+        """Factory on the -sync flag (ref: src/server.cpp:224-231)."""
+        if get_flag("sync", False):
+            log.info("Create a sync server")
+            return SyncServer(zoo)
+        log.debug("Create a async server")
+        return Server(zoo)
+
+    def register_table(self, server_table) -> int:
+        self._store.append(server_table)
+        return len(self._store) - 1
+
+    # ref: src/server.cpp:36-46
+    def _process_get(self, msg: Message) -> None:
+        with monitor("SERVER_PROCESS_GET"):
+            reply = msg.create_reply_message()
+            # The reply goes out even if table logic raises — a swallowed
+            # reply would deadlock the requester's waiter forever.
+            try:
+                reply.data = self._store[msg.table_id].process_get(msg.data)
+            finally:
+                self.send_to(actors.COMMUNICATOR, reply)
+
+    # ref: src/server.cpp:48-58
+    def _process_add(self, msg: Message) -> None:
+        with monitor("SERVER_PROCESS_ADD"):
+            reply = msg.create_reply_message()
+            try:
+                self._store[msg.table_id].process_add(msg.data)
+            finally:
+                self.send_to(actors.COMMUNICATOR, reply)
+
+
+class _VectorClock:
+    """SyncServer's specialized vector clock (ref: src/server.cpp:81-137).
+
+    ``update(i)`` ticks worker i's local clock and returns True exactly when
+    the global clock catches up to the max local clock (all workers level).
+    ``finish_train(i)`` retires worker i (clock -> +inf).
+    """
+
+    def __init__(self, n: int):
+        self._local = [0.0] * n
+        self.global_clock = 0.0
+
+    def local_clock(self, i: int) -> float:
+        return self._local[i]
+
+    def _max_finite(self) -> float:
+        finite = [v for v in self._local if v != _INF]
+        return max([self.global_clock] + finite)
+
+    def update(self, i: int) -> bool:
+        self._local[i] += 1
+        if self.global_clock < min(self._local):
+            self.global_clock += 1
+            if self.global_clock == self._max_finite():
+                return True
+        return False
+
+    def finish_train(self, i: int) -> bool:
+        self._local[i] = _INF
+        if self.global_clock < min(self._local):
+            self.global_clock = min(self._local)
+            if self.global_clock == self._max_finite():
+                return True
+        return False
+
+
+class SyncServer(Server):
+    """BSP server (ref: src/server.cpp:67-222).
+
+    Assumes all workers issue the same number of Adds/Gets per iteration.
+    Faster workers' requests are cached and drained when the global clock
+    advances; ``Server_Finish_Train`` releases stragglers at shutdown.
+    """
+
+    def __init__(self, zoo) -> None:
+        super().__init__(zoo)
+        self.register_handler(MsgType.Server_Finish_Train,
+                              self._process_finish_train)
+        n = zoo.num_workers
+        self._get_clocks = _VectorClock(n)
+        self._add_clocks = _VectorClock(n)
+        self._num_waited_add = [0] * n
+        self._add_cache: Deque[Message] = collections.deque()
+        self._get_cache: Deque[Message] = collections.deque()
+
+    # ref: src/server.cpp:141-163
+    def _process_add(self, msg: Message) -> None:
+        worker = self._zoo.rank_to_worker_id(msg.src)
+        if (self._get_clocks.local_clock(worker)
+                > self._get_clocks.global_clock):
+            self._add_cache.append(msg)
+            self._num_waited_add[worker] += 1
+            return
+        super()._process_add(msg)
+        if self._add_clocks.update(worker):
+            assert not self._add_cache
+            self._drain_get_cache()
+
+    # ref: src/server.cpp:165-188
+    def _process_get(self, msg: Message) -> None:
+        worker = self._zoo.rank_to_worker_id(msg.src)
+        if (self._add_clocks.local_clock(worker)
+                > self._add_clocks.global_clock
+                or self._num_waited_add[worker] > 0):
+            self._get_cache.append(msg)
+            return
+        super()._process_get(msg)
+        if self._get_clocks.update(worker):
+            self._drain_add_cache()
+
+    # ref: src/server.cpp:190-213
+    def _process_finish_train(self, msg: Message) -> None:
+        worker = self._zoo.rank_to_worker_id(msg.src)
+        if self._add_clocks.finish_train(worker):
+            assert not self._add_cache
+            self._drain_get_cache()
+        if self._get_clocks.finish_train(worker):
+            assert not self._get_cache
+            self._drain_add_cache()
+
+    def _drain_get_cache(self) -> None:
+        while self._get_cache:
+            get_msg = self._get_cache.popleft()
+            worker = self._zoo.rank_to_worker_id(get_msg.src)
+            Server._process_get(self, get_msg)
+            leveled = self._get_clocks.update(worker)
+            assert not leveled
+    def _drain_add_cache(self) -> None:
+        while self._add_cache:
+            add_msg = self._add_cache.popleft()
+            worker = self._zoo.rank_to_worker_id(add_msg.src)
+            Server._process_add(self, add_msg)
+            leveled = self._add_clocks.update(worker)
+            assert not leveled
+            self._num_waited_add[worker] -= 1

@@ -1,0 +1,70 @@
+"""Worker actor: routes table requests to server shards.
+
+TPU-native equivalent of the reference's ``Worker``
+(ref: include/multiverso/worker.h:12-25, src/worker.cpp:12-89). On Get/Add
+it asks the table to ``partition`` the request into per-server-shard blob
+lists, re-arms the table's waiter to the shard count, and sends one message
+per shard through the communicator; on replies it hands the payload back to
+the table and counts down the waiter.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.message import Message, MsgType
+from ..util.dashboard import monitor
+from . import actor as actors
+from .actor import Actor
+
+
+class Worker(Actor):
+    def __init__(self, zoo) -> None:
+        super().__init__(actors.WORKER, zoo)
+        self._cache: List = []  # registered WorkerTables, indexed by table id
+        self.register_handler(MsgType.Request_Get, self._process_get)
+        self.register_handler(MsgType.Request_Add, self._process_add)
+        self.register_handler(MsgType.Reply_Get, self._process_reply_get)
+        self.register_handler(MsgType.Reply_Add, self._process_reply_add)
+
+    def register_table(self, worker_table) -> int:
+        self._cache.append(worker_table)
+        return len(self._cache) - 1
+
+    # ref: src/worker.cpp:30-51
+    def _process_get(self, msg: Message) -> None:
+        with monitor("WORKER_PROCESS_GET"):
+            self._partition_and_send(msg, MsgType.Request_Get)
+
+    # ref: src/worker.cpp:53-76
+    def _process_add(self, msg: Message) -> None:
+        with monitor("WORKER_PROCESS_ADD"):
+            self._partition_and_send(msg, MsgType.Request_Add)
+
+    def _partition_and_send(self, msg: Message, msg_type: MsgType) -> None:
+        table = self._cache[msg.table_id]
+        try:
+            partitions = table.partition(msg.data, msg_type)
+        except Exception:
+            # Release the caller's waiter before surfacing the error — a
+            # hung Wait() would mask the real failure.
+            table.reset(msg.msg_id, 0)
+            raise
+        table.reset(msg.msg_id, len(partitions))
+        for server_id, blobs in partitions.items():
+            shard = Message(src=self._zoo.rank,
+                            dst=self._zoo.server_rank(server_id),
+                            msg_type=msg_type,
+                            table_id=msg.table_id, msg_id=msg.msg_id)
+            shard.data = list(blobs)
+            self.send_to(actors.COMMUNICATOR, shard)
+
+    # ref: src/worker.cpp:78-84
+    def _process_reply_get(self, msg: Message) -> None:
+        table = self._cache[msg.table_id]
+        table.process_reply_get(msg.data)
+        table.notify(msg.msg_id)
+
+    # ref: src/worker.cpp:86-88
+    def _process_reply_add(self, msg: Message) -> None:
+        self._cache[msg.table_id].notify(msg.msg_id)

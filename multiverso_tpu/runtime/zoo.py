@@ -1,0 +1,287 @@
+"""Zoo: per-rank runtime singleton — bootstrap, routing, barrier.
+
+TPU-native equivalent of the reference's ``Zoo``
+(ref: include/multiverso/zoo.h:19-85, src/zoo.cpp:41-188). One Zoo per rank;
+a process normally hosts exactly one (the TPU deployment: one JAX process,
+role=ALL, tables sharded over the local device mesh), but may host several
+*virtual ranks* on a shared ``LocalFabric`` — the moral equivalent of the
+reference's ``mpirun -np N`` single-host tests, without MPI.
+
+Start order mirrors the reference (ref: src/zoo.cpp:73-102): controller on
+rank 0, communicator, register with the controller to learn the global
+rank→worker_id/server_id map, then server and worker actors, then a barrier.
+The ``-ma`` flag skips the PS entirely (model-average mode,
+ref: src/zoo.cpp:49).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.blob import Blob
+from ..core.message import Message, MsgType
+from ..core.node import Node, Role, is_server, is_worker, role_from_string
+from ..util import log
+from ..util.configure import (define_bool, define_string, get_flag,
+                              parse_cmd_flags)
+from ..util.mt_queue import MtQueue
+from . import actor as actors
+from .communicator import Communicator
+from .controller import Controller
+from .net import LocalFabric, NetInterface
+from .server import Server
+from .worker import Worker
+
+define_string("ps_role", "default", "none / worker / server / default(all)")
+define_bool("ma", False, "model-average mode: skip the parameter server")
+define_bool("sync", False, "BSP sync server")
+
+CONTROLLER_RANK = 0
+
+_ABORT = object()  # mailbox sentinel: unblocks control waits on abort
+
+
+class ClusterAborted(RuntimeError):
+    """Raised out of blocking control calls after Zoo.abort()."""
+
+
+_tls = threading.local()
+_default_zoo: Optional["Zoo"] = None
+
+
+def current_zoo() -> "Zoo":
+    zoo = getattr(_tls, "zoo", None) or _default_zoo
+    if zoo is None:
+        raise RuntimeError("multiverso not initialized: call mv.init() first")
+    return zoo
+
+
+def set_thread_zoo(zoo: Optional["Zoo"]) -> None:
+    _tls.zoo = zoo
+
+
+class Zoo:
+    def __init__(self) -> None:
+        self._net: Optional[NetInterface] = None
+        self._actors: Dict[str, object] = {}
+        self.mailbox: MtQueue = MtQueue()
+        self._nodes: List[Node] = []
+        self._num_workers = 0
+        self._num_servers = 0
+        self._started = False
+        self._aborted = False
+        self._role_override: Optional[str] = None
+        self._worker_table_count = 0
+        self._server_table_count = 0
+        self._server_tables: List = []  # owned for cleanup + checkpoint
+
+    # -- lifecycle (ref: src/zoo.cpp:41-60) --
+    def start(self, argv: Optional[List[str]] = None,
+              net: Optional[NetInterface] = None,
+              role: Optional[str] = None) -> List[str]:
+        """``role`` overrides the -ps_role flag for this zoo (the flag
+        registry is process-global; virtual ranks with heterogeneous roles
+        need a per-zoo override)."""
+        remaining = parse_cmd_flags(argv)
+        self._net = net if net is not None else LocalFabric(1).endpoint(0)
+        self._role_override = role
+        if not get_flag("ma"):
+            self._start_ps()
+        self._started = True
+        log.debug("Rank %d: multiverso started", self.rank)
+        return remaining
+
+    def stop(self, finalize_net: bool = True) -> None:
+        """ref: src/zoo.cpp:52-60,104-114."""
+        if not self._started:
+            return
+        if not get_flag("ma"):
+            self._stop_ps(finalize_net)
+        if finalize_net:
+            self._net.finalize()
+        self._actors.clear()
+        self._server_tables.clear()
+        self._started = False
+        log.debug("Rank %d: multiverso shut down", self.rank)
+
+    def _start_ps(self) -> None:
+        role = int(role_from_string(self._role_override
+                                    or get_flag("ps_role")))
+        self._nodes = [Node(rank=r, role=int(Role.NONE))
+                       for r in range(self.net_size)]
+        self._nodes[self.rank].role = role
+        # Start order is non-trivial (ref: src/zoo.cpp:83-99): the
+        # controller must be routable before any register traffic lands.
+        if self.rank == CONTROLLER_RANK:
+            Controller(self).start()
+        Communicator(self).start()
+        self._register_node(role)
+        if is_server(role):
+            Server.get_server(self).start()
+        if is_worker(role):
+            Worker(self).start()
+        self.barrier()
+
+    def _stop_ps(self, finalize_net: bool = True) -> None:
+        # After an abort the graceful drain (finish_train + barrier) would
+        # block on peers that are gone; tear the actors down directly.
+        if not self._aborted:
+            if get_flag("sync"):
+                self.finish_train()
+            self.barrier()
+        # Reverse start order (ref: src/zoo.cpp:104-113); communicator last
+        # so in-flight replies still route.
+        for name in (actors.WORKER, actors.SERVER, actors.CONTROLLER):
+            actor = self._actors.get(name)
+            if actor is not None:
+                actor.stop()
+        comm = self._actors.get(actors.COMMUNICATOR)
+        if comm is not None:
+            comm.stop(finalize_net=finalize_net)
+
+    # -- registration protocol (ref: src/zoo.cpp:116-145) --
+    def _register_node(self, role: int) -> None:
+        msg = Message(src=self.rank, dst=CONTROLLER_RANK,
+                      msg_type=MsgType.Control_Register)
+        msg.push(Blob(np.array([self.rank, role], dtype=np.int32)))
+        self.send_to(actors.COMMUNICATOR, msg)
+        reply = self._pop_control()
+        assert reply is not None and reply.type == MsgType.Control_Reply_Register
+        table = reply.data[0].as_array(np.int32).reshape(-1, 4)
+        counts = reply.data[1].as_array(np.int32)
+        for rank, node_role, worker_id, server_id in table:
+            node = self._nodes[rank]
+            node.role = int(node_role)
+            node.worker_id = int(worker_id)
+            node.server_id = int(server_id)
+        self._num_workers = int(counts[0])
+        self._num_servers = int(counts[1])
+        log.debug("Rank %d registered: workers=%d servers=%d",
+                  self.rank, self._num_workers, self._num_servers)
+
+    # -- identity --
+    @property
+    def net(self) -> NetInterface:
+        return self._net
+
+    @property
+    def rank(self) -> int:
+        return self._net.rank if self._net is not None else 0
+
+    @property
+    def size(self) -> int:
+        return self.net_size
+
+    @property
+    def net_size(self) -> int:
+        return self._net.size if self._net is not None else 1
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    @property
+    def num_servers(self) -> int:
+        return self._num_servers
+
+    def rank_to_worker_id(self, rank: int) -> int:
+        return self._nodes[rank].worker_id
+
+    def rank_to_server_id(self, rank: int) -> int:
+        return self._nodes[rank].server_id
+
+    def worker_rank(self, worker_id: int) -> int:
+        for node in self._nodes:
+            if node.worker_id == worker_id:
+                return node.rank
+        return -1
+
+    def server_rank(self, server_id: int) -> int:
+        for node in self._nodes:
+            if node.server_id == server_id:
+                return node.rank
+        return -1
+
+    @property
+    def worker_id(self) -> int:
+        return self.rank_to_worker_id(self.rank)
+
+    @property
+    def server_id(self) -> int:
+        return self.rank_to_server_id(self.rank)
+
+    # -- actor registry / routing (ref: src/zoo.cpp:64-71,146-149) --
+    def register_actor(self, actor) -> None:
+        self._actors[actor.name] = actor
+
+    def deregister_actor(self, actor) -> None:
+        self._actors.pop(actor.name, None)
+
+    def send_to(self, name: str, msg: Message) -> None:
+        actor = self._actors.get(name)
+        if actor is None:
+            raise RuntimeError(f"no actor named {name!r} on rank {self.rank}")
+        actor.receive(msg)
+
+    route = send_to  # alias used by the communicator's inbound path
+
+    # -- abort: unblock every control wait after a peer failure --
+    def abort(self) -> None:
+        """Mark this zoo dead and wake any thread blocked in barrier() or
+        registration. Used by LocalCluster when a sibling rank errors —
+        without it, mispaired barriers hang the whole cluster."""
+        self._aborted = True
+        self.mailbox.push(_ABORT)
+
+    def _pop_control(self):
+        reply = self.mailbox.pop()
+        if reply is _ABORT or self._aborted:
+            raise ClusterAborted(f"rank {self.rank}: cluster aborted")
+        return reply
+
+    # -- collective control (ref: src/zoo.cpp:152-176) --
+    def barrier(self) -> None:
+        msg = Message(src=self.rank, dst=CONTROLLER_RANK,
+                      msg_type=MsgType.Control_Barrier)
+        self.send_to(actors.COMMUNICATOR, msg)
+        reply = self._pop_control()
+        assert reply is not None and reply.type == MsgType.Control_Reply_Barrier
+
+    def finish_train(self) -> None:
+        """Retire this rank's worker from the BSP clocks on all servers."""
+        if self.worker_id < 0:
+            return
+        for server_id in range(self._num_servers):
+            msg = Message(src=self.rank, dst=self.server_rank(server_id),
+                          msg_type=MsgType.Server_Finish_Train)
+            self.send_to(actors.COMMUNICATOR, msg)
+
+    # -- table registration (ref: src/zoo.cpp:178-186) --
+    def register_worker_table(self, worker_table) -> int:
+        worker = self._actors.get(actors.WORKER)
+        if worker is None:
+            raise RuntimeError("no worker actor on this rank")
+        tid = worker.register_table(worker_table)
+        self._worker_table_count = tid + 1
+        return tid
+
+    def register_server_table(self, server_table) -> int:
+        server = self._actors.get(actors.SERVER)
+        if server is None:
+            raise RuntimeError("no server actor on this rank")
+        tid = server.register_table(server_table)
+        self._server_tables.append(server_table)
+        self._server_table_count = tid + 1
+        return tid
+
+    @property
+    def server_tables(self) -> List:
+        return self._server_tables
+
+
+def set_default_zoo(zoo: Optional[Zoo]) -> None:
+    global _default_zoo
+    _default_zoo = zoo

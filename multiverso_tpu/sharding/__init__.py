@@ -1,0 +1,5 @@
+"""Device-mesh and sharding helpers (the ICI-native layer)."""
+
+from .mesh import (SHARD_AXIS, device_count, local_mesh, make_mesh,  # noqa: F401
+                   padded_size, replicated, row_sharded, sharded_1d,
+                   zeros_sharded)

@@ -1,0 +1,131 @@
+"""Runtime tests: zoo bootstrap, registration, barrier, vector clocks.
+
+Mirrors the reference's in-process PS environment trick
+(ref: Test/unittests/multiverso_env.h:9-31) and multi-rank integration
+tests run under mpirun (ref: deploy/docker/Dockerfile:100-110), here on an
+in-process virtual cluster.
+"""
+
+import threading
+import time
+
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.runtime.cluster import LocalCluster
+from multiverso_tpu.runtime.server import _VectorClock
+
+
+def test_init_shutdown_single_rank():
+    mv.init([])
+    assert mv.rank() == 0
+    assert mv.size() == 1
+    assert mv.num_workers() == 1
+    assert mv.num_servers() == 1
+    assert mv.worker_id() == 0
+    assert mv.server_id() == 0
+    mv.barrier()
+    mv.shutdown()
+
+
+def test_init_parses_flags_and_returns_rest():
+    rest = mv.init(["prog", "-sync=true", "-other_thing=1"])
+    assert rest == ["prog", "-other_thing=1"]
+    from multiverso_tpu.util.configure import get_flag
+    assert get_flag("sync") is True
+    mv.shutdown()
+
+
+def test_multirank_registration_assigns_dense_ids():
+    def body(rank):
+        zoo = mv.current_zoo()
+        assert zoo.size == 4
+        assert zoo.num_workers == 4
+        assert zoo.num_servers == 4
+        assert zoo.worker_id == zoo.rank  # dense, rank order
+        assert zoo.server_rank(zoo.server_id) == zoo.rank
+        zoo.barrier()
+        return zoo.rank
+
+    assert LocalCluster(4).run(body) == [0, 1, 2, 3]
+
+
+def test_worker_only_and_server_only_roles():
+    # Heterogeneous roles: rank0=all, rank1=worker-only, rank2=server-only.
+    # Dense id assignment in rank order (ref: src/controller.cpp:46-66).
+    def body(rank):
+        zoo = mv.current_zoo()
+        assert zoo.num_workers == 2
+        assert zoo.num_servers == 2
+        assert zoo.worker_rank(0) == 0 and zoo.worker_rank(1) == 1
+        assert zoo.server_rank(0) == 0 and zoo.server_rank(1) == 2
+        return (zoo.worker_id, zoo.server_id)
+
+    result = LocalCluster(3, roles=["all", "worker", "server"]).run(body)
+    assert result == [(0, 0), (1, -1), (-1, 1)]
+
+
+def test_barrier_actually_blocks():
+    arrived = []
+
+    def body(rank):
+        if rank == 1:
+            time.sleep(0.2)
+        arrived.append(rank)
+        zoo = mv.current_zoo()
+        zoo.barrier()
+        # After barrier, every rank must have arrived.
+        assert sorted(arrived) == [0, 1]
+        return True
+
+    assert LocalCluster(2).run(body) == [True, True]
+
+
+class TestVectorClock:
+    def test_update_levels_when_all_tick(self):
+        clock = _VectorClock(3)
+        assert not clock.update(0)
+        assert not clock.update(1)
+        assert clock.update(2)  # all at 1 -> global catches max
+        assert clock.global_clock == 1
+
+    def test_faster_worker_does_not_level(self):
+        clock = _VectorClock(2)
+        assert not clock.update(0)
+        assert not clock.update(0)  # worker 0 at 2, worker 1 at 0
+        assert not clock.update(1)  # min=1 -> global 1, max=2 -> not level
+        assert clock.global_clock == 1
+        assert clock.update(1)  # both at 2
+        assert clock.global_clock == 2
+
+    def test_finish_train_releases(self):
+        clock = _VectorClock(2)
+        clock.update(0)
+        assert clock.finish_train(1)  # worker 1 retires; global -> max(1)
+        assert clock.global_clock == 1
+
+
+def test_error_on_one_rank_surfaces_quickly():
+    # A failing rank must abort the cluster (unblocking sibling barriers),
+    # not hang until the join timeout.
+    def body(rank):
+        if rank == 1:
+            raise ZeroDivisionError("boom")
+        mv.current_zoo().barrier()  # would mispair without abort
+        return rank
+
+    cluster = LocalCluster(2)
+    cluster.timeout = 15
+    start = time.monotonic()
+    with pytest.raises(ZeroDivisionError):
+        cluster.run(body)
+    assert time.monotonic() - start < 10
+
+
+def test_ma_mode_skips_ps():
+    mv.init(["-ma=true"])
+    zoo = mv.current_zoo()
+    assert zoo.num_workers == 0  # no PS actors
+    with pytest.raises(RuntimeError):
+        zoo.send_to("worker", None)
+    mv.shutdown()
