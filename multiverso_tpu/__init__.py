@@ -15,6 +15,10 @@ from __future__ import annotations
 from typing import List, Optional
 
 from .runtime.zoo import Zoo, current_zoo, set_default_zoo, set_thread_zoo
+from .tables import (ArrayTableOption, KVTableOption, MatrixTableOption,
+                     create_array_table, create_kv_table,
+                     create_matrix_table, create_table)
+from .updater import AddOption, GetOption
 from .util.configure import set_flag as _set_flag
 
 __version__ = "0.1.0"
@@ -29,8 +33,14 @@ def init(argv: Optional[List[str]] = None) -> List[str]:
 
 def shutdown(finalize_net: bool = True) -> None:
     """MV_ShutDown (ref: src/multiverso.cpp:20-23)."""
-    current_zoo().stop(finalize_net)
-    set_default_zoo(None)
+    from .runtime import zoo as zoo_mod
+    zoo = current_zoo()
+    zoo.stop(finalize_net)
+    # Clear only the slot this zoo actually occupies.
+    if getattr(zoo_mod._tls, "zoo", None) is zoo:
+        set_thread_zoo(None)
+    if zoo_mod._default_zoo is zoo:
+        set_default_zoo(None)
 
 
 def barrier() -> None:

@@ -64,15 +64,22 @@ class Actor:
             msg = self.mailbox.pop()
             if msg is None:
                 break
-            handler = self._handlers.get(int(msg.header[2]))
-            if handler is None:
-                log.error("actor %s: unhandled message type %d",
-                          self.name, msg.header[2])
-                continue
-            try:
-                handler(msg)
-            except Exception:  # noqa: BLE001 - actor must not die silently
-                log.error("actor %s: handler for type %d raised",
-                          self.name, msg.header[2])
-                import traceback
-                traceback.print_exc()
+            self._safe_dispatch(msg)
+
+    def _safe_dispatch(self, msg: Message) -> None:
+        """Dispatch one message; an actor must not die silently."""
+        try:
+            self._dispatch(msg)
+        except Exception:  # noqa: BLE001
+            log.error("actor %s: handling message type %d raised",
+                      self.name, msg.header[2])
+            import traceback
+            traceback.print_exc()
+
+    def _dispatch(self, msg: Message) -> None:
+        handler = self._handlers.get(int(msg.header[2]))
+        if handler is None:
+            log.error("actor %s: unhandled message type %d",
+                      self.name, msg.header[2])
+            return
+        handler(msg)

@@ -18,7 +18,6 @@ from typing import Optional
 
 from ..core.message import (Message, is_controller_bound, is_server_bound,
                             is_worker_bound)
-from ..util import log
 from . import actor as actors
 from .actor import Actor
 
@@ -45,20 +44,9 @@ class Communicator(Actor):
             self._recv_thread.join(timeout=30)
         super().stop()
 
-    # Outbound path: actor mailbox -> wire (or loop back locally).
-    def _main(self) -> None:
-        while True:
-            msg = self.mailbox.pop()
-            if msg is None:
-                break
-            try:
-                self._process_message(msg)
-            except Exception:  # noqa: BLE001
-                log.error("communicator: send path raised")
-                import traceback
-                traceback.print_exc()
-
-    def _process_message(self, msg: Message) -> None:
+    # Outbound path: actor mailbox -> wire (or loop back locally); every
+    # message type goes through the same route-or-send dispatch.
+    def _dispatch(self, msg: Message) -> None:
         if msg.dst != self._zoo.rank:
             self._net.send(msg)
         else:
@@ -71,12 +59,7 @@ class Communicator(Actor):
             msg = self._net.recv()
             if msg is None:
                 break
-            try:
-                self._local_forward(msg)
-            except Exception:  # noqa: BLE001
-                log.error("communicator: recv routing raised")
-                import traceback
-                traceback.print_exc()
+            self._safe_dispatch(msg)
 
     # Routing rule (ref: src/communicator.cpp:13-29).
     def _local_forward(self, msg: Message) -> None:

@@ -62,8 +62,12 @@ class Worker(Actor):
     # ref: src/worker.cpp:78-84
     def _process_reply_get(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
-        table.process_reply_get(msg.data)
-        table.notify(msg.msg_id)
+        # notify() must run even if reply handling raises — a swallowed
+        # notify deadlocks the requester's wait().
+        try:
+            table.process_reply_get(msg.data)
+        finally:
+            table.notify(msg.msg_id)
 
     # ref: src/worker.cpp:86-88
     def _process_reply_add(self, msg: Message) -> None:

@@ -1,0 +1,113 @@
+"""Distributed key-value table (hash-sharded map).
+
+TPU-native equivalent of the reference's ``KVWorkerTable/KVServerTable``
+(ref: include/multiverso/table/kv_table.h:18-124). Semantics preserved:
+
+- partition by ``key % num_servers`` (ref: kv_table.h:48-65);
+- server ``process_add`` does ``table[k] += v`` (ref: kv_table.h:99-106);
+- the worker keeps a local ``raw`` dict refreshed by Get
+  (ref: kv_table.h:40, 68-75).
+
+KV state is host-side (it backs control-plane things like WordEmbedding's
+word counts, ref: Applications/WordEmbedding/src/communicator.cpp:251-259);
+numeric bulk state belongs in Array/Matrix tables in HBM. Unlike the
+reference we also implement Store/Load (the reference raises
+"Not implemented", ref: kv_table.h:108-114).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.blob import Blob
+from ..core.message import MsgType
+from ..util.log import CHECK
+from .table_interface import ServerTable, WorkerTable
+
+
+class KVWorker(WorkerTable):
+    def __init__(self, key_dtype=np.int64, val_dtype=np.float32, zoo=None):
+        super().__init__(zoo=zoo)
+        self.key_dtype = np.dtype(key_dtype)
+        self.val_dtype = np.dtype(val_dtype)
+        self._num_server = self._zoo.num_servers
+        self.raw: Dict[int, float] = {}
+
+    def get(self, keys) -> Dict[int, float]:
+        """Refresh ``raw`` for the requested keys and return it."""
+        keys = np.ascontiguousarray(keys, dtype=self.key_dtype).reshape(-1)
+        self.wait(self.get_async_raw(Blob(keys.view(np.uint8))))
+        return self.raw
+
+    def add(self, keys, values) -> None:
+        self.wait(self.add_async(keys, values))
+
+    def add_async(self, keys, values) -> int:
+        keys = np.ascontiguousarray(keys, dtype=self.key_dtype).reshape(-1)
+        values = np.ascontiguousarray(values,
+                                      dtype=self.val_dtype).reshape(-1)
+        CHECK(keys.size == values.size, "keys/values size mismatch")
+        return self.add_async_raw(Blob(keys.view(np.uint8)),
+                                  Blob(values.view(np.uint8)))
+
+    # ref: kv_table.h:48-65
+    def partition(self, blobs, msg_type) -> Dict[int, List[Blob]]:
+        keys = blobs[0].as_array(self.key_dtype)
+        values = blobs[1].as_array(self.val_dtype) \
+            if len(blobs) >= 2 else None
+        out: Dict[int, List[Blob]] = {}
+        dest = (keys % self._num_server).astype(np.int64)
+        for sid in np.unique(dest):
+            mask = dest == sid
+            shard = [Blob(np.ascontiguousarray(keys[mask]).view(np.uint8))]
+            if values is not None:
+                shard.append(
+                    Blob(np.ascontiguousarray(values[mask]).view(np.uint8)))
+            out[int(sid)] = shard
+        return out
+
+    # ref: kv_table.h:68-75
+    def process_reply_get(self, reply_blobs: List[Blob]) -> None:
+        keys = reply_blobs[0].as_array(self.key_dtype)
+        values = reply_blobs[1].as_array(self.val_dtype)
+        for k, v in zip(keys, values):
+            self.raw[int(k)] = v.item()
+
+
+class KVServer(ServerTable):
+    def __init__(self, key_dtype=np.int64, val_dtype=np.float32, zoo=None):
+        super().__init__(zoo=zoo)
+        self.key_dtype = np.dtype(key_dtype)
+        self.val_dtype = np.dtype(val_dtype)
+        self._store: Dict[int, float] = {}
+
+    # ref: kv_table.h:99-106
+    def process_add(self, blobs: List[Blob]) -> None:
+        keys = blobs[0].as_array(self.key_dtype)
+        values = blobs[1].as_array(self.val_dtype)
+        for k, v in zip(keys, values):
+            self._store[int(k)] = self._store.get(int(k), 0) + v.item()
+
+    # ref: kv_table.h:88-97
+    def process_get(self, blobs: List[Blob]) -> List[Blob]:
+        keys = blobs[0].as_array(self.key_dtype)
+        values = np.array([self._store.get(int(k), 0) for k in keys],
+                          dtype=self.val_dtype)
+        return [blobs[0], Blob(values.view(np.uint8))]
+
+    def store(self, stream) -> None:
+        payload = pickle.dumps(self._store)
+        stream.write(struct.pack("<Q", len(payload)))
+        stream.write(payload)
+
+    def load(self, stream) -> None:
+        (length,) = struct.unpack("<Q", stream.read(8))
+        self._store = pickle.loads(stream.read(length))
+
+    @property
+    def raw(self) -> Dict[int, float]:
+        return self._store

@@ -1,0 +1,351 @@
+"""2-D row-sharded distributed matrix table (dense + sparse).
+
+TPU-native equivalent of the reference's matrix tables — the row-sharded
+``MatrixWorkerTable/MatrixServerTable``
+(ref: include/multiverso/table/matrix_table.h:16-127,
+src/table/matrix_table.cpp:13-468) unified with the sparse variant's
+per-worker dirty-row tracking (ref: src/table/sparse_matrix_table.cpp:14-314,
+include/multiverso/table/matrix.h:14-123). Semantics preserved:
+
+- row-range partition: each server owns ``num_row/num_servers`` rows, last
+  takes the remainder; degenerate one-row-per-server layout when
+  ``num_row < num_servers`` (ref: matrix_table.cpp:23-45);
+- request keys: sentinel -1 = whole table, else an int32 row-id vector;
+  row -> server by ``row / (num_row/num_servers)`` clamped to the last
+  server (ref: matrix_table.cpp:267-276);
+- whole-table Get replies carry ``[keys, values, server_id]`` so the worker
+  places the shard; row Gets reply ``[row_ids, values]``
+  (ref: matrix_table.cpp:317-341, 420-454);
+- sparse mode: the server keeps an ``up_to_date[worker][row]`` bitmap —
+  an Add dirties the row for every *other* worker, a Get (whose GetOption
+  names the worker) returns only that worker's dirty rows and marks them
+  clean (ref: sparse_matrix_table.cpp:200-258); with pipelining each
+  worker counts as two logical consumers (ref: sparse_matrix_table.cpp:
+  184-197).
+
+TPU redesign: each server shard is a row-sharded ``jax.Array``; row
+Gets/Adds are XLA gather/scatter jitted over power-of-two row buckets, and
+whole-table ops are single fused device ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.blob import Blob
+from ..core.message import MsgType
+from ..sharding import mesh as meshlib
+from ..updater import AddOption, GetOption, UpdateEngine, create_rule
+from ..updater.engine import pad_ids
+from ..util.log import CHECK
+from .table_interface import ServerTable, WorkerTable
+
+_ALL_KEY = np.array([-1], dtype=np.int32)
+
+
+def row_offsets(num_row: int, num_servers: int) -> List[int]:
+    """Row ranges per server incl. the degenerate rows<servers layout
+    (ref: matrix_table.cpp:24-41). Returns num_actual_servers+1 offsets."""
+    offsets = [0]
+    length = num_row // num_servers
+    if length > 0:
+        offset = length
+        i = 0
+        while length > 0 and offset < num_row and i + 1 < num_servers:
+            offsets.append(offset)
+            offset += length
+            i += 1
+    else:
+        offset = 1
+        i = 0
+        while offset < num_row and i + 1 < num_servers:
+            offsets.append(offset)
+            offset += 1
+            i += 1
+    offsets.append(num_row)
+    return offsets
+
+
+@dataclass
+class MatrixTableOption:
+    """ref: include/multiverso/table/matrix.h:116-123."""
+    num_row: int
+    num_col: int
+    dtype: object = np.float32
+    is_sparse: bool = False
+    is_pipeline: bool = False
+    updater_type: Optional[str] = None
+
+
+class MatrixWorker(WorkerTable):
+    def __init__(self, num_row: int, num_col: int, dtype=np.float32,
+                 is_sparse: bool = False, zoo=None):
+        super().__init__(zoo=zoo)
+        self.num_row = int(num_row)
+        self.num_col = int(num_col)
+        self.dtype = np.dtype(dtype)
+        self.is_sparse = bool(is_sparse)
+        self._offsets = row_offsets(self.num_row, self._zoo.num_servers)
+        self._num_server = len(self._offsets) - 1  # actual servers used
+        self._row_length = max(self.num_row // self._num_server, 1)
+        self._dest: Optional[np.ndarray] = None
+        self._dest_rows: Optional[Dict[int, int]] = None
+
+    # -- Get API (ref: matrix_table.cpp:58-105) --
+    def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
+        self.wait(self.get_async(out))
+        return self._dest
+
+    def get_async(self, out: Optional[np.ndarray] = None) -> int:
+        if out is None:
+            out = np.empty((self.num_row, self.num_col), self.dtype)
+        CHECK(out.shape == (self.num_row, self.num_col), "bad output shape")
+        self._dest, self._dest_rows = out, None
+        return self._request_get(Blob(_ALL_KEY.view(np.uint8)))
+
+    def get_rows(self, row_ids, out: Optional[np.ndarray] = None
+                 ) -> np.ndarray:
+        self.wait(self.get_rows_async(row_ids, out))
+        return self._dest
+
+    def get_rows_async(self, row_ids,
+                       out: Optional[np.ndarray] = None) -> int:
+        row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
+        if out is None:
+            out = np.empty((row_ids.size, self.num_col), self.dtype)
+        CHECK(out.shape == (row_ids.size, self.num_col), "bad output shape")
+        self._dest = out
+        self._dest_rows = {int(r): i for i, r in enumerate(row_ids)}
+        return self._request_get(Blob(row_ids.view(np.uint8)))
+
+    def _request_get(self, keys: Blob) -> int:
+        extra = []
+        if self.is_sparse:
+            # Sparse gets carry the asking worker's id
+            # (ref: sparse_matrix_table.h:27-43).
+            extra.append(GetOption(self._zoo.worker_id).to_blob())
+        return self.get_async_raw(keys, extra)
+
+    # -- Add API (ref: matrix_table.cpp:110-147) --
+    def add(self, delta, option: Optional[AddOption] = None) -> None:
+        self.wait(self.add_async(delta, option))
+
+    def add_async(self, delta, option: Optional[AddOption] = None) -> int:
+        delta = np.ascontiguousarray(delta, self.dtype)
+        CHECK(delta.size == self.num_row * self.num_col, "bad delta size")
+        return self.add_async_raw(Blob(_ALL_KEY.view(np.uint8)),
+                                  Blob(delta.reshape(-1)),
+                                  self._option_blob(option))
+
+    def add_rows(self, row_ids, delta,
+                 option: Optional[AddOption] = None) -> None:
+        self.wait(self.add_rows_async(row_ids, delta, option))
+
+    def add_rows_async(self, row_ids, delta,
+                       option: Optional[AddOption] = None) -> int:
+        row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
+        delta = np.ascontiguousarray(delta, self.dtype)
+        CHECK(delta.size == row_ids.size * self.num_col, "bad delta size")
+        return self.add_async_raw(Blob(row_ids.view(np.uint8)),
+                                  Blob(delta.reshape(-1)),
+                                  self._option_blob(option))
+
+    def _option_blob(self, option: Optional[AddOption]) -> Blob:
+        if option is None:
+            option = AddOption(worker_id=max(self._zoo.worker_id, 0))
+        return option.to_blob()
+
+    # -- partition (ref: matrix_table.cpp:234-315) --
+    def partition(self, blobs, msg_type) -> Dict[int, List[Blob]]:
+        keys = blobs[0].as_array(np.int32)
+        out: Dict[int, List[Blob]] = {}
+        if keys.size == 1 and keys[0] == -1:
+            is_add = msg_type == MsgType.Request_Add
+            values = blobs[1].as_array(self.dtype) if is_add else None
+            for sid in range(self._num_server):
+                shard = [blobs[0]]
+                if values is not None:
+                    lo, hi = self._offsets[sid], self._offsets[sid + 1]
+                    shard.append(Blob(
+                        values[lo * self.num_col:hi * self.num_col]))
+                    if len(blobs) == 3:
+                        shard.append(blobs[2])
+                elif len(blobs) == 2:  # sparse Get: GetOption rides along
+                    shard.append(blobs[1])
+                out[sid] = shard
+            return out
+
+        # Row-id requests: bucket rows by owning server
+        # (ref: matrix_table.cpp:267-276).
+        is_add = msg_type == MsgType.Request_Add
+        dest = np.minimum(keys // self._row_length, self._num_server - 1)
+        values = blobs[1].as_array(self.dtype).reshape(
+            keys.size, self.num_col) if is_add else None
+        for sid in np.unique(dest):
+            mask = dest == sid
+            shard = [Blob(np.ascontiguousarray(keys[mask]).view(np.uint8))]
+            if values is not None:
+                shard.append(Blob(np.ascontiguousarray(values[mask])))
+                if len(blobs) == 3:
+                    shard.append(blobs[2])
+            elif len(blobs) == 2:  # sparse GetOption
+                shard.append(blobs[1])
+            out[int(sid)] = shard
+        return out
+
+    # -- replies (ref: matrix_table.cpp:317-341) --
+    def process_reply_get(self, reply_blobs: List[Blob]) -> None:
+        keys = reply_blobs[0].as_array(np.int32)
+        if keys.size == 1 and keys[0] == -1:
+            server_id = int(reply_blobs[2].as_array(np.int32)[0])
+            lo, hi = self._offsets[server_id], self._offsets[server_id + 1]
+            values = reply_blobs[1].as_array(self.dtype)
+            self._dest[lo:hi] = values.reshape(hi - lo, self.num_col)
+            return
+        values = reply_blobs[1].as_array(self.dtype).reshape(
+            keys.size, self.num_col)
+        if self._dest_rows is None:
+            # Sparse whole-table get: dirty rows land at their global index.
+            self._dest[keys] = values
+        else:
+            for i, key in enumerate(keys):
+                self._dest[self._dest_rows[int(key)]] = values[i]
+
+
+class MatrixServer(ServerTable):
+    def __init__(self, num_row: int, num_col: int, dtype=np.float32,
+                 is_sparse: bool = False, is_pipeline: bool = False,
+                 zoo=None, updater_type: Optional[str] = None,
+                 random_init: Optional[tuple] = None, seed: int = 0):
+        super().__init__(zoo=zoo)
+        self.dtype = np.dtype(dtype)
+        self.num_col = int(num_col)
+        self.is_sparse = bool(is_sparse)
+        offsets = row_offsets(int(num_row), self._zoo.num_servers)
+        sid = self._zoo.server_id
+        self.server_id = sid
+        if sid >= len(offsets) - 1:
+            self.row_offset, self.my_rows = 0, 0  # idle server (rows<servers)
+        else:
+            self.row_offset = offsets[sid]
+            self.my_rows = offsets[sid + 1] - offsets[sid]
+
+        mesh = meshlib.local_mesh()
+        self._sharding = meshlib.row_sharded(mesh)
+        padded = meshlib.padded_size(max(self.my_rows, 1),
+                                     meshlib.device_count(mesh))
+        self._data = meshlib.zeros_sharded((padded, self.num_col),
+                                           self.dtype, self._sharding)
+        if random_init is not None:
+            # Server ctor variant with uniform random init
+            # (ref: matrix_table.cpp:372-384).
+            lo, hi = random_init
+            rng = np.random.default_rng(seed + sid)
+            host = np.zeros((padded, self.num_col), self.dtype)
+            host[:self.my_rows] = rng.uniform(
+                lo, hi, (self.my_rows, self.num_col)).astype(self.dtype)
+            self._data = jax.device_put(host, self._sharding)
+        rule = None if updater_type is None \
+            else create_rule(updater_type, dtype)
+        num_workers = max(self._zoo.num_workers, 1)
+        self._engine = UpdateEngine(rule, (padded, self.num_col),
+                                    self.dtype, num_workers, self._sharding)
+        # Sparse staleness bitmap: one slot per logical consumer; pipelined
+        # workers count twice (ref: sparse_matrix_table.cpp:184-197).
+        consumers = num_workers * (2 if is_pipeline else 1)
+        self._up_to_date = np.zeros((consumers, self.my_rows), dtype=bool) \
+            if is_sparse else None
+
+    # -- Add (ref: matrix_table.cpp:386-418, sparse_matrix_table.cpp:200-223)
+    def process_add(self, blobs: List[Blob]) -> None:
+        CHECK(len(blobs) in (2, 3), "add needs [keys, values(, option)]")
+        option = AddOption.from_blob(blobs[2]) if len(blobs) == 3 else None
+        keys = blobs[0].as_array(np.int32)
+        if keys.size == 1 and keys[0] == -1:
+            delta = blobs[1].as_array(self.dtype)
+            CHECK(delta.size == self.my_rows * self.num_col,
+                  "whole-table add size mismatch")
+            self._data = self._engine.apply_dense(
+                self._data, delta.reshape(self.my_rows, self.num_col), option)
+            if self._up_to_date is not None:
+                self._mark_dirty(slice(None), option)
+            return
+        local_rows = keys - self.row_offset
+        delta = blobs[1].as_array(self.dtype).reshape(keys.size, self.num_col)
+        self._data = self._engine.apply_rows(self._data, local_rows, delta,
+                                             option)
+        if self._up_to_date is not None:
+            self._mark_dirty(local_rows, option)
+
+    def _mark_dirty(self, rows, option: Optional[AddOption]) -> None:
+        """An Add invalidates the rows for every consumer except the adder
+        (ref: sparse_matrix_table.cpp:200-223)."""
+        self._up_to_date[:, rows] = False
+        if option is not None and 0 <= option.worker_id < \
+                self._up_to_date.shape[0]:
+            self._up_to_date[option.worker_id, rows] = True
+
+    # -- Get (ref: matrix_table.cpp:420-454, sparse_matrix_table.cpp:226-309)
+    def process_get(self, blobs: List[Blob]) -> List[Blob]:
+        keys = blobs[0].as_array(np.int32)
+        if keys.size == 1 and keys[0] == -1:
+            if self._up_to_date is not None and len(blobs) >= 2:
+                return self._sparse_get_all(GetOption.from_blob(blobs[1]))
+            return [blobs[0], Blob(self._values()),
+                    Blob(np.array([self.server_id], dtype=np.int32))]
+        local_rows = keys - self.row_offset
+        padded_rows = pad_ids(local_rows, self._data.shape[0])
+        values = self._gather(self._data, padded_rows)[:keys.size]
+        if self._up_to_date is not None and len(blobs) >= 2:
+            opt = GetOption.from_blob(blobs[1])
+            if 0 <= opt.worker_id < self._up_to_date.shape[0]:
+                self._up_to_date[opt.worker_id, local_rows] = True
+        return [blobs[0], Blob(values)]
+
+    def _sparse_get_all(self, opt: GetOption) -> List[Blob]:
+        """Return only this worker's dirty rows
+        (ref: sparse_matrix_table.cpp:226-258)."""
+        wid = opt.worker_id
+        CHECK(0 <= wid < self._up_to_date.shape[0], "bad worker id")
+        dirty = np.nonzero(~self._up_to_date[wid])[0].astype(np.int32)
+        self._up_to_date[wid, dirty] = True
+        padded_rows = pad_ids(dirty, self._data.shape[0])
+        values = self._gather(self._data, padded_rows)[:dirty.size]
+        return [Blob(dirty + self.row_offset), Blob(values)]
+
+    @functools.cached_property
+    def _gather(self):
+        return jax.jit(lambda data, rows: data.at[rows].get(
+            mode="fill", fill_value=0))
+
+    def _values(self):
+        """Fresh-buffer snapshot of the logical rows (see ArrayServer._values
+        — the live storage is donated away by the next update)."""
+        return self._snapshot(self._data)
+
+    @functools.cached_property
+    def _snapshot(self):
+        n = self.my_rows
+        return jax.jit(lambda x: jax.numpy.copy(x[:n]))
+
+    # -- checkpoint (ref: matrix_table.cpp:456-464) --
+    def store(self, stream) -> None:
+        stream.write(np.asarray(self._values()).tobytes())
+
+    def load(self, stream) -> None:
+        raw = stream.read(self.my_rows * self.num_col * self.dtype.itemsize)
+        values = np.frombuffer(raw, dtype=self.dtype).reshape(
+            self.my_rows, self.num_col)
+        padded = self._data.shape[0]
+        host = np.zeros((padded, self.num_col), self.dtype)
+        host[:self.my_rows] = values
+        self._data = jax.device_put(host, self._sharding)
+
+    @property
+    def raw(self):
+        return self._values()

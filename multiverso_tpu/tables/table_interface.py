@@ -1,0 +1,146 @@
+"""Worker/Server table bases: the async Get/Add plumbing.
+
+TPU-native equivalent of the reference's table interface
+(ref: include/multiverso/table_interface.h:24-75, src/table.cpp:13-112).
+Contract preserved exactly:
+
+- ``get_async``/``add_async`` allocate a per-request ``Waiter``, build a
+  request message and hand it to the worker actor (ref: src/table.cpp:41-82);
+- the worker actor calls ``partition`` to split the request into
+  per-server-shard blob lists and re-arms the waiter via ``reset(msg_id, n)``
+  (ref: src/worker.cpp:30-76);
+- each server reply triggers ``process_reply_get`` + ``notify`` until the
+  waiter releases ``wait(msg_id)`` (ref: src/worker.cpp:78-88,
+  src/table.cpp:84-111).
+
+``ServerTable`` is ``Serializable`` — ``store``/``load`` stream the shard
+state for checkpointing (ref: include/multiverso/table_interface.h:61-75).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..core.blob import Blob
+from ..core.message import Message, MsgType
+from ..runtime import actor as actors
+from ..runtime.zoo import current_zoo
+from ..util.dashboard import monitor
+from ..util.waiter import Waiter
+
+
+class WorkerTable:
+    """Client-side handle; lives on every worker rank."""
+
+    def __init__(self, zoo=None):
+        self._zoo = zoo if zoo is not None else current_zoo()
+        self.table_id: int = self._zoo.register_worker_table(self)
+        self._msg_id = 0
+        self._waitings: Dict[int, Waiter] = {}
+        self._mutex = threading.Lock()
+
+    # -- public sync API (ref: src/table.cpp:29-38) --
+    def get_raw(self, keys: Blob, extra: Sequence[Blob] = ()) -> None:
+        with monitor("WORKER_TABLE_SYNC_GET"):
+            self.wait(self.get_async_raw(keys, extra))
+
+    def add_raw(self, keys: Blob, values: Blob,
+                option_blob: Optional[Blob] = None) -> None:
+        with monitor("WORKER_TABLE_SYNC_ADD"):
+            self.wait(self.add_async_raw(keys, values, option_blob))
+
+    # -- async API (ref: src/table.cpp:41-82) --
+    def get_async_raw(self, keys: Blob, extra: Sequence[Blob] = ()) -> int:
+        msg_id = self._new_request()
+        msg = Message(src=self._zoo.rank, dst=-1,
+                      msg_type=MsgType.Request_Get,
+                      table_id=self.table_id, msg_id=msg_id)
+        msg.push(keys)
+        for blob in extra:
+            msg.push(blob)
+        self._zoo.send_to(actors.WORKER, msg)
+        return msg_id
+
+    def add_async_raw(self, keys: Blob, values: Blob,
+                      option_blob: Optional[Blob] = None) -> int:
+        msg_id = self._new_request()
+        msg = Message(src=self._zoo.rank, dst=-1,
+                      msg_type=MsgType.Request_Add,
+                      table_id=self.table_id, msg_id=msg_id)
+        msg.push(keys)
+        msg.push(values)
+        if option_blob is not None:
+            msg.push(option_blob)
+        self._zoo.send_to(actors.WORKER, msg)
+        return msg_id
+
+    def _new_request(self) -> int:
+        with self._mutex:
+            self._msg_id += 1
+            msg_id = self._msg_id
+            self._waitings[msg_id] = Waiter(1)
+        return msg_id
+
+    # -- waiter plumbing, driven by the worker actor
+    #    (ref: src/table.cpp:84-111) --
+    def wait(self, msg_id: int, timeout: Optional[float] = None) -> bool:
+        with self._mutex:
+            waiter = self._waitings.get(msg_id)
+        if waiter is None:
+            return True  # already completed
+        ok = waiter.wait(timeout=timeout)
+        if ok:
+            with self._mutex:
+                self._waitings.pop(msg_id, None)
+        return ok
+
+    def reset(self, msg_id: int, num_wait: int) -> None:
+        with self._mutex:
+            waiter = self._waitings.get(msg_id)
+        if waiter is not None:
+            waiter.reset(num_wait)
+
+    def notify(self, msg_id: int) -> None:
+        with self._mutex:
+            waiter = self._waitings.get(msg_id)
+        if waiter is not None:
+            waiter.notify()
+
+    # -- virtuals (ref: table_interface.h:44-51) --
+    def partition(self, blobs: List[Blob],
+                  msg_type: MsgType) -> Dict[int, List[Blob]]:
+        """Split a request's blobs into {server_id: [blobs]}."""
+        raise NotImplementedError
+
+    def process_reply_get(self, reply_blobs: List[Blob]) -> None:
+        raise NotImplementedError
+
+    @property
+    def zoo(self):
+        return self._zoo
+
+
+class ServerTable:
+    """Storage-side shard; lives on every server rank. Serializable
+    (ref: table_interface.h:61-75)."""
+
+    def __init__(self, zoo=None):
+        self._zoo = zoo if zoo is not None else current_zoo()
+        self.table_id: int = self._zoo.register_server_table(self)
+
+    def process_add(self, blobs: List[Blob]) -> None:
+        raise NotImplementedError
+
+    def process_get(self, blobs: List[Blob]) -> List[Blob]:
+        raise NotImplementedError
+
+    def store(self, stream) -> None:
+        raise NotImplementedError
+
+    def load(self, stream) -> None:
+        raise NotImplementedError
+
+    @property
+    def zoo(self):
+        return self._zoo

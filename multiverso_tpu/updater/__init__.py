@@ -1,0 +1,6 @@
+"""Server-side optimizers as jit-compiled donated-buffer updates."""
+
+from .engine import UpdateEngine, bucket_size, pad_rows  # noqa: F401
+from .options import AddOption, GetOption  # noqa: F401
+from .rules import (AdaGradRule, DefaultRule, MomentumRule, SGDRule,  # noqa: F401
+                    UpdaterRule, create_rule)

@@ -1,0 +1,131 @@
+"""Jit-compiled updater engine: in-place donated updates on sharded state.
+
+Binds an ``UpdaterRule`` to a concrete table: owns the optimizer state
+(sharded like the table data) and the jitted dense/row update callables.
+Donation (``donate_argnums``) lets XLA update the table buffers in place in
+HBM — the TPU equivalent of the reference server's in-place OpenMP loops
+(ref: src/updater/updater.cpp:24-31).
+
+Row-sparse calls are padded to power-of-two bucket sizes so XLA compiles a
+small, bounded set of scatter programs instead of one per distinct row
+count (the host-variable-shape hazard called out in SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..sharding import mesh as meshlib
+from .options import AddOption
+from .rules import UpdaterRule, create_rule
+
+_DEFAULT_HYP = AddOption().hyper_array()
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Smallest power of two >= n (>= minimum)."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+class UpdateEngine:
+    """Applies a rule to a table's device array with donated buffers."""
+
+    def __init__(self, rule: Optional[UpdaterRule], shape, dtype,
+                 num_workers: int, sharding=None):
+        self.rule = rule if rule is not None else create_rule(dtype=dtype)
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        state = self.rule.init_state(self.shape, self.dtype, num_workers)
+        if state is not None and sharding is not None:
+            # Optimizer state lives shard-aligned with the data; the
+            # per-worker leading axis (adagrad) is replicated.
+            state = jax.device_put(state, _state_sharding(state, sharding))
+        self._state = state
+
+        # Table storage is padded to the mesh shard count (uneven shardings
+        # are not device_put-able); deltas arrive logical-sized and are
+        # zero-extended *inside* the jit so XLA fuses the pad into the
+        # update — no host-side copy.
+        def dense_padded(data, st, delta, hyp, worker_id):
+            if data.shape[0] != delta.shape[0]:
+                pad = ((0, data.shape[0] - delta.shape[0]),) \
+                    + ((0, 0),) * (delta.ndim - 1)
+                delta = jax.numpy.pad(delta, pad)
+            return self.rule.dense(data, st, delta, hyp, worker_id)
+
+        self._dense = jax.jit(dense_padded, donate_argnums=(0, 1))
+        self._rows = jax.jit(self.rule.rows, donate_argnums=(0, 1))
+
+    def apply_dense(self, data, delta, option: Optional[AddOption] = None):
+        hyp, worker_id = _unpack(option)
+        data, self._state = self._dense(data, self._state, delta,
+                                        hyp, worker_id)
+        return data
+
+    def apply_rows(self, data, row_ids, delta,
+                   option: Optional[AddOption] = None):
+        """``row_ids`` int32[k], ``delta`` [k, ...]; pads to a power-of-two
+        bucket with out-of-range indices (dropped by scatter)."""
+        hyp, worker_id = _unpack(option)
+        row_ids, delta = pad_rows(row_ids, delta, self.shape[0])
+        data, self._state = self._rows(data, self._state, row_ids, delta,
+                                       hyp, worker_id)
+        return data
+
+    @property
+    def state(self):
+        return self._state
+
+
+def _unpack(option: Optional[AddOption]) -> Tuple[np.ndarray, np.ndarray]:
+    if option is None:
+        return _DEFAULT_HYP, np.int32(0)
+    return option.hyper_array(), np.int32(max(option.worker_id, 0))
+
+
+def pad_ids(row_ids, num_rows: int) -> np.ndarray:
+    """Pad a row-id vector to the next bucket size with an out-of-range
+    sentinel (gather fills zeros, scatter drops)."""
+    row_ids = np.asarray(row_ids, dtype=np.int32)
+    b = bucket_size(row_ids.shape[0])
+    if b != row_ids.shape[0]:
+        row_ids = np.concatenate(
+            [row_ids, np.full(b - row_ids.shape[0], num_rows,
+                              dtype=np.int32)])
+    return row_ids
+
+
+def pad_rows(row_ids, delta, num_rows: int):
+    """Pad (row_ids, delta) to the next bucket size; padding rows index
+    out-of-range so scatter drops them and gather fills zeros."""
+    row_ids = np.asarray(row_ids, dtype=np.int32)
+    k = row_ids.shape[0]
+    b = bucket_size(k)
+    if b != k:
+        row_ids = np.concatenate(
+            [row_ids, np.full(b - k, num_rows, dtype=np.int32)])
+        pad_shape = (b - k,) + tuple(np.shape(delta))[1:]
+        delta = np.concatenate(
+            [np.asarray(delta), np.zeros(pad_shape, np.asarray(delta).dtype)])
+    return row_ids, delta
+
+
+@functools.lru_cache(maxsize=None)
+def _state_sharding_cached(ndim_state: int, data_sharding):
+    mesh = data_sharding.mesh
+    spec = data_sharding.spec
+    # Prepend replicated axes for any leading state dims beyond the data's.
+    pad = ndim_state - len(spec)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*([None] * pad + list(spec))))
+
+
+def _state_sharding(state, data_sharding):
+    return _state_sharding_cached(np.ndim(state), data_sharding)

@@ -38,3 +38,7 @@ class Waiter:
     def reset(self, num_wait: int) -> None:
         with self._cond:
             self._num_wait = num_wait
+            if self._num_wait <= 0:
+                # Re-arming to zero must release anyone already blocked
+                # (e.g. a request whose partition produced no shards).
+                self._cond.notify_all()

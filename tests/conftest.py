@@ -8,12 +8,17 @@ driver's dryrun uses). Must run before the first jax import.
 import os
 
 # Force, don't setdefault: the TPU environment pre-sets JAX_PLATFORMS to the
-# hardware platform, but tests need the 8-device virtual CPU mesh.
+# hardware platform and its sitecustomize imports jax at interpreter start,
+# so the env var alone is ignored — jax.config.update is the reliable path.
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,141 @@
+"""Updater numerics: parity with the reference formulas (SURVEY.md §2.4).
+
+Reference formulas validated against independent numpy implementations:
+default add (ref: src/updater/updater.cpp:24-31), sgd
+(ref: sgd_updater.h:15-19), momentum (ref: momentum_updater.h:17-26),
+adagrad intended semantics (ref: adagrad_updater.h:23-41; see
+rules.py docstring for the reference's accumulator bugs we do not clone).
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.updater import (AddOption, GetOption, UpdateEngine,
+                                    bucket_size, create_rule, pad_rows)
+from multiverso_tpu.updater.rules import ADAGRAD_EPS
+
+
+def make_engine(rule_name, shape, num_workers=2, dtype=np.float32):
+    return UpdateEngine(create_rule(rule_name), shape, dtype, num_workers)
+
+
+class TestOptions:
+    def test_add_option_roundtrip(self):
+        opt = AddOption(worker_id=3, momentum=0.9, learning_rate=0.05,
+                        rho=0.2, lambda_=0.7)
+        back = AddOption.from_blob(opt.to_blob())
+        assert back.worker_id == 3
+        assert back.momentum == pytest.approx(0.9)
+        assert back.learning_rate == pytest.approx(0.05)
+        assert back.rho == pytest.approx(0.2)
+        assert back.lambda_ == pytest.approx(0.7)
+
+    def test_add_option_wire_layout(self):
+        # 5 slots x 4 bytes; slot 0 is an int32 (union layout,
+        # ref: updater.h:53-69).
+        blob = AddOption(worker_id=7).to_blob()
+        assert blob.size == 20
+        assert int(blob.as_array(np.int32)[0]) == 7
+
+    def test_get_option_roundtrip(self):
+        assert GetOption.from_blob(GetOption(5).to_blob()).worker_id == 5
+
+
+class TestDenseRules:
+    def test_default_adds(self):
+        eng = make_engine("default", (8,))
+        data = np.zeros(8, np.float32)
+        out = eng.apply_dense(data, np.arange(8, dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(out), np.arange(8))
+
+    def test_sgd_subtracts(self):
+        eng = make_engine("sgd", (4,))
+        out = eng.apply_dense(np.full(4, 10, np.float32),
+                              np.full(4, 3, np.float32))
+        np.testing.assert_allclose(np.asarray(out), np.full(4, 7.0))
+
+    def test_momentum_smooths(self):
+        eng = make_engine("momentum", (3,))
+        opt = AddOption(momentum=0.5)
+        data = np.zeros(3, np.float32)
+        delta = np.ones(3, np.float32)
+        # smooth = .5*0 + .5*1 = .5 ; data = -0.5
+        data = eng.apply_dense(data, delta, opt)
+        np.testing.assert_allclose(np.asarray(data), -0.5 * np.ones(3))
+        # smooth = .5*.5 + .5*1 = .75 ; data = -1.25
+        data = eng.apply_dense(data, delta, opt)
+        np.testing.assert_allclose(np.asarray(data), -1.25 * np.ones(3))
+
+    def test_adagrad_per_worker_state(self):
+        eng = make_engine("adagrad", (2,), num_workers=2)
+        opt0 = AddOption(worker_id=0, learning_rate=0.1, rho=0.1)
+        data = np.zeros(2, np.float32)
+        delta = np.full(2, 0.05, np.float32)
+        grad = 0.05 / 0.1
+        g_sqr = grad * grad
+        expect = -0.1 * grad / np.sqrt(g_sqr + ADAGRAD_EPS)
+        data = eng.apply_dense(data, delta, opt0)
+        np.testing.assert_allclose(np.asarray(data), np.full(2, expect),
+                                   rtol=1e-5)
+        # Worker 1 has its own fresh accumulator -> same first step again.
+        data2 = eng.apply_dense(np.zeros(2, np.float32), delta,
+                                AddOption(worker_id=1, learning_rate=0.1,
+                                          rho=0.1))
+        np.testing.assert_allclose(np.asarray(data2), np.full(2, expect),
+                                   rtol=1e-5)
+        # Worker 0 again: accumulator doubled.
+        data = eng.apply_dense(np.zeros(2, np.float32), delta, opt0)
+        expect2 = -0.1 * grad / np.sqrt(2 * g_sqr + ADAGRAD_EPS)
+        np.testing.assert_allclose(np.asarray(data), np.full(2, expect2),
+                                   rtol=1e-5)
+
+    def test_int_table_always_default(self):
+        rule = create_rule("sgd", dtype=np.int32)
+        assert rule.name == "default"  # ref: updater.cpp:42-45
+
+
+class TestRowRules:
+    def test_default_rows_scatter_add(self):
+        eng = make_engine("default", (6, 3))
+        data = np.zeros((6, 3), np.float32)
+        rows = np.array([1, 4], np.int32)
+        delta = np.ones((2, 3), np.float32)
+        out = np.asarray(eng.apply_rows(data, rows, delta))
+        assert out[1].sum() == 3 and out[4].sum() == 3
+        assert out.sum() == 6
+
+    def test_duplicate_rows_compound_for_add(self):
+        eng = make_engine("default", (4, 2))
+        out = np.asarray(eng.apply_rows(
+            np.zeros((4, 2), np.float32), np.array([2, 2], np.int32),
+            np.ones((2, 2), np.float32)))
+        np.testing.assert_allclose(out[2], [2.0, 2.0])
+
+    def test_momentum_rows_tracks_state(self):
+        eng = make_engine("momentum", (5, 2))
+        opt = AddOption(momentum=0.5)
+        rows = np.array([3], np.int32)
+        delta = np.ones((1, 2), np.float32)
+        data = np.zeros((5, 2), np.float32)
+        data = np.asarray(eng.apply_rows(data, rows, delta, opt))
+        np.testing.assert_allclose(data[3], [-0.5, -0.5])
+        data = np.asarray(eng.apply_rows(data, rows, delta, opt))
+        np.testing.assert_allclose(data[3], [-1.25, -1.25])
+        assert data[0].sum() == 0  # untouched rows
+
+    def test_padding_rows_are_dropped(self):
+        rows, delta = pad_rows(np.array([1], np.int32),
+                               np.ones((1, 2), np.float32), num_rows=4)
+        assert len(rows) == bucket_size(1)
+        assert (rows[1:] == 4).all()  # out-of-range sentinel
+        eng = make_engine("default", (4, 2))
+        out = np.asarray(eng.apply_rows(np.zeros((4, 2), np.float32),
+                                        np.array([1], np.int32),
+                                        np.ones((1, 2), np.float32)))
+        assert out.sum() == 2  # only the real row landed
+
+    def test_bucket_sizes_bound_recompiles(self):
+        assert bucket_size(1) == 8
+        assert bucket_size(8) == 8
+        assert bucket_size(9) == 16
+        assert bucket_size(1000) == 1024
