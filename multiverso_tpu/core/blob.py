@@ -18,6 +18,12 @@ from typing import Any
 import numpy as np
 
 
+def is_device_array(x: Any) -> bool:
+    """True for jax.Array-like payloads (duck-typed so core stays
+    jax-import-free)."""
+    return not isinstance(x, np.ndarray) and hasattr(x, "addressable_shards")
+
+
 class Blob:
     __slots__ = ("_data",)
 
@@ -46,6 +52,18 @@ class Blob:
     @property
     def data(self) -> Any:
         return self._data
+
+    @property
+    def on_device(self) -> bool:
+        """True when the payload is a device array (jax.Array) that has not
+        been materialized to host bytes. Device blobs flow through the PS
+        stack with zero host copies."""
+        return is_device_array(self._data)
+
+    def typed(self, dtype=np.float32) -> Any:
+        """Typed payload without forcing a host transfer: the device array
+        itself when on device, else the host view."""
+        return self._data if self.on_device else self.as_array(dtype)
 
     def _host(self) -> np.ndarray:
         if not isinstance(self._data, np.ndarray):

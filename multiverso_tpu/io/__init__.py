@@ -1,0 +1,4 @@
+"""Stream IO and checkpointing."""
+
+from .stream import (Stream, StreamFactory, TextReader,  # noqa: F401
+                     load_checkpoint, save_checkpoint)

@@ -1,0 +1,149 @@
+"""URI-dispatched stream IO + checkpoint driver.
+
+TPU-native equivalent of the reference's IO layer
+(ref: include/multiverso/io/io.h:24-132, src/io/io.cpp:8-62): a
+``StreamFactory`` keyed on URI scheme (``file://`` default; other schemes
+register via ``register_scheme`` — the reference gates ``hdfs://`` behind a
+build flag the same way), buffered ``TextReader.get_line``, and
+``Serializable`` Store/Load driven over every server table.
+
+The checkpoint driver (``save_checkpoint``/``load_checkpoint``) recreates
+the upstream end-to-end checkpoint/restore flow whose tests were dropped
+from the reference snapshot (ref: deploy/docker/Dockerfile:105-106 runs
+``multiverso.test checkpoint|restore`` against Test/main.cpp which no
+longer has them).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+from urllib.parse import urlparse
+
+from ..runtime.zoo import current_zoo
+from ..util import log
+
+
+class Stream:
+    """Binary stream (ref: io.h:24-60)."""
+
+    def __init__(self, fileobj, path: str):
+        self._f = fileobj
+        self.path = path
+
+    def write(self, data: bytes) -> int:
+        return self._f.write(data)
+
+    def read(self, size: int = -1) -> bytes:
+        return self._f.read(size)
+
+    def good(self) -> bool:
+        return not self._f.closed
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _open_local(path: str, mode: str) -> Stream:
+    binary_mode = mode if "b" in mode else mode + "b"
+    parent = os.path.dirname(path)
+    if parent and "w" in mode:
+        os.makedirs(parent, exist_ok=True)
+    return Stream(open(path, binary_mode), path)
+
+
+class StreamFactory:
+    """Scheme-dispatched open (ref: io.h:62-117, io.cpp:8-21)."""
+
+    _openers: Dict[str, Callable[[str, str], Stream]] = {}
+
+    @classmethod
+    def register_scheme(cls, scheme: str,
+                        opener: Callable[[str, str], Stream]) -> None:
+        cls._openers[scheme] = opener
+
+    @classmethod
+    def get_stream(cls, uri: str, mode: str = "r") -> Stream:
+        parsed = urlparse(uri)
+        scheme = parsed.scheme or "file"
+        if scheme == "file" or len(scheme) == 1:  # len==1: windows drive
+            if parsed.scheme == "file":
+                # file://tmp/x parses 'tmp' into netloc — a relative-path
+                # URI; rejoin it rather than silently opening /x.
+                path = (parsed.netloc + parsed.path) if parsed.netloc \
+                    else parsed.path
+            else:
+                path = uri
+            return _open_local(path, mode)
+        opener = cls._openers.get(scheme)
+        if opener is None:
+            raise ValueError(f"unsupported stream scheme: {scheme}://")
+        return opener(uri, mode)
+
+
+class TextReader:
+    """Buffered line reader (ref: io.h:119-132, io.cpp:33-55)."""
+
+    def __init__(self, uri: str, buf_size: int = 1 << 20):
+        self._stream = StreamFactory.get_stream(uri, "r")
+        self._buf_size = buf_size
+        self._buf = b""
+        self._eof = False
+
+    def get_line(self) -> Optional[str]:
+        while True:
+            newline = self._buf.find(b"\n")
+            if newline >= 0:
+                line, self._buf = self._buf[:newline], self._buf[newline + 1:]
+                return line.decode("utf-8", errors="replace").rstrip("\r")
+            if self._eof:
+                if self._buf:
+                    line, self._buf = self._buf, b""
+                    return line.decode("utf-8", errors="replace")
+                return None
+            chunk = self._stream.read(self._buf_size)
+            if not chunk:
+                self._eof = True
+            else:
+                self._buf += chunk
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+# -- checkpoint driver over every registered server table --
+
+def save_checkpoint(uri_prefix: str, zoo=None) -> int:
+    """Store every server table shard under ``{prefix}.table{i}.rank{r}``.
+    Returns the number of tables written."""
+    zoo = zoo if zoo is not None else current_zoo()
+    tables = zoo.server_tables
+    for i, table in enumerate(tables):
+        with StreamFactory.get_stream(
+                f"{uri_prefix}.table{i}.rank{zoo.rank}", "w") as stream:
+            table.store(stream)
+    log.info("rank %d: checkpointed %d tables to %s",
+             zoo.rank, len(tables), uri_prefix)
+    return len(tables)
+
+
+def load_checkpoint(uri_prefix: str, zoo=None) -> int:
+    """Load every server table shard saved by ``save_checkpoint``."""
+    zoo = zoo if zoo is not None else current_zoo()
+    tables = zoo.server_tables
+    for i, table in enumerate(tables):
+        with StreamFactory.get_stream(
+                f"{uri_prefix}.table{i}.rank{zoo.rank}", "r") as stream:
+            table.load(stream)
+    log.info("rank %d: restored %d tables from %s",
+             zoo.rank, len(tables), uri_prefix)
+    return len(tables)

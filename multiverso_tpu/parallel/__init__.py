@@ -1,0 +1,4 @@
+"""Device-mesh collectives and model-average training (the ICI data plane)."""
+
+from .collective import (allreduce_mesh, pmean_mesh, psum_scalar)  # noqa: F401
+from .ma import MASGDStep, model_average  # noqa: F401

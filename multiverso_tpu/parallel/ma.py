@@ -1,0 +1,72 @@
+"""Model-average (MA) mode: the PS-bypass training path.
+
+The reference's ``-ma`` flag skips the parameter server entirely and the
+app calls ``MV_Aggregate`` (MPI allreduce) on its parameter buffer each
+step (ref: src/zoo.cpp:49, src/multiverso.cpp:53-56,
+Test/test_allreduce.cpp:10-19). On TPU the equivalent has two layers:
+
+- control plane (host, cross-rank): ``model_average`` — transport
+  allreduce of a host array divided by the worker count;
+- data plane (device mesh): ``MASGDStep`` — one jitted SPMD step where each
+  device computes gradients on its microbatch and ``lax.pmean`` merges them
+  over ICI, which is the collapsed form of train-locally-then-average.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ..runtime.zoo import current_zoo
+from ..sharding import mesh as meshlib
+
+
+def model_average(data: np.ndarray, zoo=None) -> np.ndarray:
+    """Cross-rank parameter average: allreduce / num_ranks
+    (ref usage: binding apps divide MV_Aggregate output by worker count)."""
+    zoo = zoo if zoo is not None else current_zoo()
+    total = zoo.net.allreduce(np.asarray(data))
+    return total / zoo.net.size
+
+
+class MASGDStep:
+    """Data-parallel SGD step over the device mesh.
+
+    ``loss_fn(params, batch) -> scalar``; batches arrive with the leading
+    axis split over the mesh. One jit: forward, backward, pmean(grads)
+    over ICI, SGD update. Params stay replicated; the collective is the
+    only cross-device traffic — the TPU-native fusion of Multiverso's
+    train-then-MV_Aggregate loop.
+    """
+
+    def __init__(self, loss_fn: Callable, mesh=None, lr: float = 0.01):
+        self.mesh = mesh if mesh is not None else meshlib.local_mesh()
+        self.lr = lr
+        axes = tuple(self.mesh.axis_names)
+
+        def device_step(params, batch, lr_arr):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axes), grads)
+            loss = jax.lax.pmean(loss, axes)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr_arr * g, params, grads)
+            return new_params, loss
+
+        batch_spec = P(axes)
+        self._step = jax.jit(shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P(), batch_spec, P()),
+            out_specs=(P(), P()),
+        ), donate_argnums=(0,))
+
+    def __call__(self, params, batch):
+        lr_arr = jnp.asarray(self.lr, dtype=jnp.float32)
+        params, loss = self._step(params, batch, lr_arr)
+        return params, float(np.asarray(loss))

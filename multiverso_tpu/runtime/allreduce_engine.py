@@ -1,0 +1,187 @@
+"""Hand-rolled collectives over point-to-point transport (control plane).
+
+Functional equivalent of the reference's ``AllreduceEngine``
+(ref: include/multiverso/net/allreduce_engine.h:80-168,
+src/net/allreduce_engine.cpp:31-172): a Bruck-style allgather and a
+recursive-halving reduce-scatter composed into an allreduce, with the same
+size-based algorithm choice (small payloads take the allgather path,
+ref: allreduce_engine.cpp:31-54).
+
+On TPU this engine is the *fallback* path: the data plane rides XLA
+collectives over ICI (``multiverso_tpu.parallel``); this host-side engine
+exists for model-average mode over the control transport where no device
+mesh spans the ranks (the reference's ``-ma`` mode bypasses the PS the same
+way, ref: src/zoo.cpp:49). It drives the raw endpoint directly, so it must
+only run when the PS actors are down (ma mode) — exactly the reference's
+usage pattern.
+
+The algorithms are implemented from their standard formulations (Bruck
+doubling allgather; recursive halving with an initial fold of surplus ranks
+onto a power-of-two group), not transcribed from the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.blob import Blob
+from ..core.message import Message, MsgType
+from .net import NetInterface
+
+_SMALL_BYTES = 4096  # allgather-based path threshold (ref: engine.cpp:33)
+
+
+class AllreduceEngine:
+    def __init__(self, net: NetInterface):
+        self._net = net
+        self.rank = net.rank
+        self.size = net.size
+        self._stash = {}  # (src, tag) -> blob, for early-arriving rounds
+
+    # -- raw paired exchange over the message transport --
+    def _send(self, dst: int, payload: np.ndarray, tag: int) -> None:
+        msg = Message(src=self.rank, dst=dst, msg_type=MsgType.Default,
+                      msg_id=tag)
+        msg.push(Blob(np.ascontiguousarray(payload)))
+        self._net.send(msg)
+
+    def _recv(self, src: int, tag: int, dtype) -> np.ndarray:
+        """Tag-matched receive: a fast peer's next-round message may arrive
+        before the one this round is waiting on; stash and keep draining."""
+        key = (src, tag)
+        while key not in self._stash:
+            msg = self._net.recv(timeout=120)
+            if msg is None:
+                raise RuntimeError("allreduce engine: transport closed")
+            self._stash[(msg.src, msg.msg_id)] = msg.data[0]
+        return self._stash.pop(key).as_array(dtype)
+
+    def _exchange(self, peer: int, payload: np.ndarray,
+                  tag: int) -> np.ndarray:
+        """Blocking sendrecv with one peer (ref: mpi_net.h:269-287)."""
+        self._send(peer, payload, tag)
+        return self._recv(peer, tag, payload.dtype)
+
+    # -- public API (ref: allreduce_engine.h:96-118) --
+    def allreduce(self, data: np.ndarray,
+                  reducer: Callable = np.add) -> np.ndarray:
+        data = np.asarray(data)
+        if self.size == 1:
+            return data.copy()
+        if data.nbytes < _SMALL_BYTES or data.size < self.size:
+            # Small path: allgather everyone's buffer, reduce locally
+            # (ref: allreduce_engine.cpp:34-43).
+            stacked = self.allgather(data)
+            out = stacked[0]
+            for part in stacked[1:]:
+                out = reducer(out, part)
+            return out
+        return self._reduce_scatter_allgather(data, reducer)
+
+    def allgather(self, data: np.ndarray) -> list:
+        """Bruck doubling allgather: after round k every rank holds 2^(k+1)
+        blocks; blocks are sent to rank-2^k and received from rank+2^k
+        (ref: allreduce_engine.cpp:90-117, allreduce_topo.cpp:20-37)."""
+        n = self.size
+        blocks = [np.asarray(data)]
+        tag = 1000
+        distance = 1
+        while distance < n:
+            dst = (self.rank - distance) % n
+            src = (self.rank + distance) % n
+            count = min(distance, n - distance)
+            payload = np.concatenate(
+                [b.reshape(-1) for b in blocks[:count]])
+            self._send(dst, payload, tag)
+            incoming = self._recv(src, tag,
+                                  blocks[0].dtype).reshape(count, -1)
+            for i in range(count):
+                blocks.append(incoming[i].reshape(blocks[0].shape))
+            distance *= 2
+            tag += 1
+        # blocks[j] is the buffer of rank (self.rank + j) % n; rotate to
+        # rank order.
+        ordered = [None] * n
+        for j, block in enumerate(blocks[:n]):
+            ordered[(self.rank + j) % n] = block
+        return ordered
+
+    def _reduce_scatter_allgather(self, data: np.ndarray,
+                                  reducer: Callable) -> np.ndarray:
+        """Large path: recursive-halving reduce-scatter then allgather of
+        the reduced segments (ref: allreduce_engine.cpp:44-54,120-172)."""
+        n = self.size
+        flat = np.asarray(data).reshape(-1).copy()
+        # Fold surplus ranks onto the largest power-of-two group (the
+        # reference pairs each surplus rank with a group leader,
+        # ref: allreduce_topo.cpp:58-168).
+        pow2 = 1
+        while pow2 * 2 <= n:
+            pow2 *= 2
+        surplus = n - pow2
+        tag = 2000
+        if self.rank >= pow2:
+            # Surplus rank: hand the whole buffer to its leader, then wait
+            # for the final result.
+            leader = self.rank - pow2
+            self._send(leader, flat, tag)
+            result = self._recv(leader, tag + 900, flat.dtype)
+            return result.reshape(np.asarray(data).shape)
+        if self.rank < surplus:
+            incoming = self._recv(self.rank + pow2, tag, flat.dtype)
+            flat = reducer(flat, incoming)
+
+        # Recursive halving among the pow2 group: segment boundaries are
+        # even splits of the flat buffer.
+        bounds = np.linspace(0, flat.size, pow2 + 1).astype(np.int64)
+        lo, hi = 0, pow2
+        step_tag = tag + 1
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            half = (hi - lo) // 2
+            in_low = self.rank < mid
+            peer = self.rank + half if in_low else self.rank - half
+            keep = (lo, mid) if in_low else (mid, hi)
+            give = (mid, hi) if in_low else (lo, mid)
+            give_seg = flat[bounds[give[0]]:bounds[give[1]]]
+            recv_seg = self._exchange(peer, give_seg, step_tag)
+            seg = slice(bounds[keep[0]], bounds[keep[1]])
+            flat[seg] = reducer(flat[seg], recv_seg)
+            lo, hi = keep
+            step_tag += 1
+
+        # Allgather the reduced segments back (ring of exchanges via the
+        # Bruck machinery on the segment level).
+        my_seg = flat[bounds[self.rank]:bounds[self.rank + 1]]
+        gathered = self._gather_segments(my_seg, bounds, flat.dtype,
+                                         step_tag)
+        flat = np.concatenate(gathered)
+        if self.rank < surplus:
+            self._send(self.rank + pow2, flat, tag + 900)
+        return flat.reshape(np.asarray(data).shape)
+
+    def _gather_segments(self, my_seg, bounds, dtype, tag) -> list:
+        """Bruck doubling allgather of the (unequal) reduced segments.
+        Ownership after round r is deterministic — rank holds segments
+        {rank+j mod p : j < 2^r} — so no ids ride the wire."""
+        pow2 = len(bounds) - 1
+        have = {self.rank: np.asarray(my_seg)}
+        distance = 1
+        while distance < pow2:
+            dst = (self.rank - distance) % pow2
+            src = (self.rank + distance) % pow2
+            count = min(distance, pow2 - distance)
+            send_ids = [(self.rank + j) % pow2 for j in range(count)]
+            self._send(dst, np.concatenate([have[i] for i in send_ids]), tag)
+            raw = self._recv(src, tag, dtype)
+            offset = 0
+            for j in range(count):
+                seg_id = (src + j) % pow2
+                seg_len = int(bounds[seg_id + 1] - bounds[seg_id])
+                have[seg_id] = raw[offset:offset + seg_len]
+                offset += seg_len
+            distance *= 2
+            tag += 1
+        return [have[i] for i in range(pow2)]

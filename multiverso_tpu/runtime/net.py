@@ -59,6 +59,11 @@ class NetInterface:
         endpoint down (used for non-finalizing shutdown)."""
         self.finalize()
 
+    def allreduce(self, array: "np.ndarray") -> "np.ndarray":
+        """Sum-allreduce a host array across ranks (the transport-level
+        collective behind MV_Aggregate, ref: mpi_net.h:147-151)."""
+        raise NotImplementedError
+
     @property
     def name(self) -> str:
         return type(self).__name__
@@ -76,6 +81,13 @@ class LocalFabric:
         self._size = size
         self._inboxes: List[MtQueue] = [MtQueue() for _ in range(size)]
         self._lock = threading.Lock()
+        # Shared-memory allreduce state (one in-flight collective at a time,
+        # like the reference's serialized MPI_Allreduce).
+        self._ar_cond = threading.Condition()
+        self._ar_acc = None
+        self._ar_result = None
+        self._ar_joined = 0
+        self._ar_generation = 0
 
     @property
     def size(self) -> int:
@@ -91,6 +103,30 @@ class LocalFabric:
 
     def inbox(self, rank: int) -> MtQueue:
         return self._inboxes[rank]
+
+    def allreduce(self, array) -> "np.ndarray":
+        import numpy as np
+        contribution = np.asarray(array)
+        with self._ar_cond:
+            generation = self._ar_generation
+            self._ar_acc = contribution.copy() if self._ar_acc is None \
+                else self._ar_acc + contribution
+            self._ar_joined += 1
+            if self._ar_joined == self._size:
+                self._ar_result = self._ar_acc
+                self._ar_acc = None
+                self._ar_joined = 0
+                self._ar_generation += 1
+                self._ar_cond.notify_all()
+            else:
+                if not self._ar_cond.wait_for(
+                        lambda: self._ar_generation > generation,
+                        timeout=120):
+                    raise TimeoutError(
+                        "allreduce: peers never joined the collective")
+            # Per-rank copy: a caller mutating its result in place must not
+            # corrupt what sibling ranks see.
+            return self._ar_result.copy()
 
 
 class LocalNet(NetInterface):
@@ -123,3 +159,6 @@ class LocalNet(NetInterface):
 
     def interrupt_recv(self) -> None:
         self._fabric.inbox(self._rank).push(_RECV_INTERRUPT)
+
+    def allreduce(self, array):
+        return self._fabric.allreduce(array)

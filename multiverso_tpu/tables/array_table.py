@@ -26,7 +26,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from ..core.blob import Blob
+from ..core.blob import Blob, is_device_array
 from ..core.message import MsgType
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, UpdateEngine, create_rule
@@ -72,16 +72,23 @@ class ArrayWorker(WorkerTable):
         self.wait(self.add_async(delta, option))
 
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
-        delta = np.ascontiguousarray(delta, dtype=self.dtype).reshape(-1)
-        CHECK(delta.size == self.size, "delta size mismatch")
+        """Accepts host or device arrays; a device delta rides the whole
+        stack without touching the host (the TPU-native hot path)."""
+        if not is_device_array(delta):
+            delta = np.ascontiguousarray(delta,
+                                         dtype=self.dtype).reshape(-1)
+        CHECK(int(np.prod(delta.shape)) == self.size, "delta size mismatch")
+        delta_blob = Blob(delta.reshape(-1))
         return self.add_async_raw(
-            Blob(_ALL_KEY.view(np.uint8)), Blob(delta),
+            Blob(_ALL_KEY.view(np.uint8)), delta_blob,
             option.to_blob() if option is not None else None)
 
     # -- partition (ref: array_table.cpp:68-86) --
     def partition(self, blobs, msg_type) -> Dict[int, List[Blob]]:
         out: Dict[int, List[Blob]] = {}
-        values = blobs[1].as_array(self.dtype) if len(blobs) >= 2 else None
+        # typed() keeps device payloads on device — the per-server slice is
+        # then a lazy device slice, not a host copy.
+        values = blobs[1].typed(self.dtype) if len(blobs) >= 2 else None
         for server_id in range(self._num_server):
             shard = [blobs[0]]
             if values is not None:
@@ -92,9 +99,28 @@ class ArrayWorker(WorkerTable):
             out[server_id] = shard
         return out
 
+    # -- device-resident Get: shards stay in HBM end to end --
+    def get_device(self):
+        """Whole-table Get returning a device array (no host transfer).
+        The reply shards are the servers' jitted snapshots in HBM."""
+        self._dest = None
+        self._device_shards: Dict[int, object] = {}
+        msg_id = self.get_async_raw(Blob(_ALL_KEY.view(np.uint8)))
+        self.wait(msg_id)
+        shards = [self._device_shards[sid]
+                  for sid in range(len(self._device_shards))]
+        self._device_shards = None
+        if len(shards) == 1:
+            return shards[0]
+        import jax.numpy as jnp
+        return jnp.concatenate(shards)
+
     # -- reply (ref: array_table.cpp:95-106) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
         server_id = int(reply_blobs[0].as_array(np.int32)[0])
+        if self._dest is None:  # device-resident get
+            self._device_shards[server_id] = reply_blobs[1].typed(self.dtype)
+            return
         values = reply_blobs[1].as_array(self.dtype)
         lo, hi = self._offsets[server_id], self._offsets[server_id + 1]
         CHECK(values.size == hi - lo, "reply shard size mismatch")
@@ -130,8 +156,9 @@ class ArrayServer(ServerTable):
     def process_add(self, blobs: List[Blob]) -> None:
         CHECK(len(blobs) in (2, 3), "add needs [keys, values(, option)]")
         option = AddOption.from_blob(blobs[2]) if len(blobs) == 3 else None
-        delta = blobs[1].as_array(self.dtype)
-        CHECK(delta.size == self.size, "add delta shard size mismatch")
+        delta = blobs[1].typed(self.dtype)  # device deltas stay on device
+        CHECK(int(np.prod(delta.shape)) == self.size,
+              "add delta shard size mismatch")
         self._data = self._engine.apply_dense(self._data, delta, option)
 
     def process_get(self, blobs: List[Blob]) -> List[Blob]:

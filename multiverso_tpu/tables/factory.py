@@ -18,9 +18,19 @@ import numpy as np
 
 from ..core.node import is_server, is_worker
 from ..runtime.zoo import current_zoo
+from ..util.configure import get_flag
 from .array_table import ArrayServer, ArrayWorker
 from .kv_table import KVServer, KVWorker
 from .matrix_table import MatrixServer, MatrixTableOption, MatrixWorker
+
+
+def _table_role(zoo) -> int:
+    if not zoo._nodes:
+        hint = " (-ma=true skips the parameter server; flags persist " \
+            "across init/shutdown like the reference's statics)" \
+            if get_flag("ma") else ""
+        raise RuntimeError(f"no parameter server on this rank{hint}")
+    return zoo._nodes[zoo.rank].role
 
 
 @dataclass
@@ -41,7 +51,7 @@ def create_array_table(size: int, dtype=np.float32,
                        updater_type: Optional[str] = None,
                        zoo=None) -> Optional[ArrayWorker]:
     zoo = zoo if zoo is not None else current_zoo()
-    role = zoo._nodes[zoo.rank].role
+    role = _table_role(zoo)
     worker = None
     if is_server(role):
         ArrayServer(size, dtype, zoo=zoo, updater_type=updater_type)
@@ -57,7 +67,7 @@ def create_matrix_table(num_row: int, num_col: int, dtype=np.float32,
                         random_init: Optional[tuple] = None, seed: int = 0,
                         zoo=None) -> Optional[MatrixWorker]:
     zoo = zoo if zoo is not None else current_zoo()
-    role = zoo._nodes[zoo.rank].role
+    role = _table_role(zoo)
     worker = None
     if is_server(role):
         MatrixServer(num_row, num_col, dtype, is_sparse=is_sparse,
@@ -74,7 +84,7 @@ def create_matrix_table(num_row: int, num_col: int, dtype=np.float32,
 def create_kv_table(key_dtype=np.int64, val_dtype=np.float32,
                     zoo=None) -> Optional[KVWorker]:
     zoo = zoo if zoo is not None else current_zoo()
-    role = zoo._nodes[zoo.rank].role
+    role = _table_role(zoo)
     worker = None
     if is_server(role):
         KVServer(key_dtype, val_dtype, zoo=zoo)

@@ -37,7 +37,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from ..core.blob import Blob
+from ..core.blob import Blob, is_device_array
 from ..core.message import MsgType
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, GetOption, UpdateEngine, create_rule
@@ -136,8 +136,11 @@ class MatrixWorker(WorkerTable):
         self.wait(self.add_async(delta, option))
 
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
-        delta = np.ascontiguousarray(delta, self.dtype)
-        CHECK(delta.size == self.num_row * self.num_col, "bad delta size")
+        """Whole-table add; device arrays stay on device end to end."""
+        if not is_device_array(delta):
+            delta = np.ascontiguousarray(delta, self.dtype)
+        CHECK(int(np.prod(delta.shape)) == self.num_row * self.num_col,
+              "bad delta size")
         return self.add_async_raw(Blob(_ALL_KEY.view(np.uint8)),
                                   Blob(delta.reshape(-1)),
                                   self._option_blob(option))
@@ -166,7 +169,7 @@ class MatrixWorker(WorkerTable):
         out: Dict[int, List[Blob]] = {}
         if keys.size == 1 and keys[0] == -1:
             is_add = msg_type == MsgType.Request_Add
-            values = blobs[1].as_array(self.dtype) if is_add else None
+            values = blobs[1].typed(self.dtype) if is_add else None
             for sid in range(self._num_server):
                 shard = [blobs[0]]
                 if values is not None:
@@ -198,11 +201,30 @@ class MatrixWorker(WorkerTable):
             out[int(sid)] = shard
         return out
 
+    # -- device-resident whole-table Get (shards stay in HBM) --
+    def get_device(self):
+        CHECK(not self.is_sparse,
+              "device get is for dense tables (sparse replies are ragged)")
+        self._dest, self._dest_rows = None, None
+        self._device_shards: Dict[int, object] = {}
+        self.wait(self._request_get(Blob(_ALL_KEY.view(np.uint8))))
+        shards = [self._device_shards[sid]
+                  for sid in range(len(self._device_shards))]
+        self._device_shards = None
+        if len(shards) == 1:
+            return shards[0]
+        import jax.numpy as jnp
+        return jnp.concatenate(shards, axis=0)
+
     # -- replies (ref: matrix_table.cpp:317-341) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
         keys = reply_blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
             server_id = int(reply_blobs[2].as_array(np.int32)[0])
+            if self._dest is None:  # device-resident get
+                self._device_shards[server_id] = \
+                    reply_blobs[1].typed(self.dtype)
+                return
             lo, hi = self._offsets[server_id], self._offsets[server_id + 1]
             values = reply_blobs[1].as_array(self.dtype)
             self._dest[lo:hi] = values.reshape(hi - lo, self.num_col)
@@ -267,8 +289,8 @@ class MatrixServer(ServerTable):
         option = AddOption.from_blob(blobs[2]) if len(blobs) == 3 else None
         keys = blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
-            delta = blobs[1].as_array(self.dtype)
-            CHECK(delta.size == self.my_rows * self.num_col,
+            delta = blobs[1].typed(self.dtype)
+            CHECK(int(np.prod(delta.shape)) == self.my_rows * self.num_col,
                   "whole-table add size mismatch")
             self._data = self._engine.apply_dense(
                 self._data, delta.reshape(self.my_rows, self.num_col), option)

@@ -1,0 +1,98 @@
+"""IO + checkpoint tests: stream roundtrip, text reader, table restore.
+
+Recreates the upstream checkpoint/restore e2e coverage referenced by the
+reference's Docker test list (ref: deploy/docker/Dockerfile:105-106) that
+was dropped from its snapshot.
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.io import (StreamFactory, TextReader, load_checkpoint,
+                               save_checkpoint)
+
+
+@pytest.fixture
+def env():
+    mv.init([])
+    yield
+    mv.shutdown()
+
+
+class TestStream:
+    def test_binary_roundtrip(self, tmp_path):
+        path = tmp_path / "blob.bin"
+        with StreamFactory.get_stream(f"file://{path}", "w") as s:
+            s.write(b"hello multiverso")
+        with StreamFactory.get_stream(f"file://{path}", "r") as s:
+            assert s.read() == b"hello multiverso"
+
+    def test_plain_path_defaults_to_file(self, tmp_path):
+        path = str(tmp_path / "plain.bin")
+        with StreamFactory.get_stream(path, "w") as s:
+            s.write(b"x")
+        with StreamFactory.get_stream(path, "r") as s:
+            assert s.read() == b"x"
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(ValueError):
+            StreamFactory.get_stream("hdfs://nn/x", "r")
+
+    def test_custom_scheme_registration(self, tmp_path):
+        calls = []
+
+        def opener(uri, mode):
+            calls.append(uri)
+            return StreamFactory.get_stream(str(tmp_path / "alt.bin"), mode)
+
+        StreamFactory.register_scheme("mem", opener)
+        try:
+            with StreamFactory.get_stream("mem://x", "w") as s:
+                s.write(b"y")
+            assert calls == ["mem://x"]
+        finally:
+            StreamFactory._openers.pop("mem", None)
+
+
+class TestTextReader:
+    def test_get_line(self, tmp_path):
+        path = tmp_path / "text.txt"
+        path.write_text("alpha\nbeta\r\ngamma")
+        reader = TextReader(str(path))
+        assert reader.get_line() == "alpha"
+        assert reader.get_line() == "beta"
+        assert reader.get_line() == "gamma"
+        assert reader.get_line() is None
+        reader.close()
+
+    def test_long_lines_cross_buffer(self, tmp_path):
+        path = tmp_path / "long.txt"
+        line = "z" * 5000
+        path.write_text(f"{line}\nshort")
+        reader = TextReader(str(path), buf_size=64)
+        assert reader.get_line() == line
+        assert reader.get_line() == "short"
+
+
+class TestCheckpoint:
+    def test_array_matrix_kv_roundtrip(self, env, tmp_path):
+        prefix = str(tmp_path / "ckpt")
+        arr = mv.create_array_table(50)
+        mat = mv.create_matrix_table(12, 4)
+        kv = mv.create_kv_table()
+        arr.add(np.arange(50, dtype=np.float32))
+        mat.add_rows(np.array([3], np.int32), np.ones((1, 4), np.float32))
+        kv.add([9], [4.5])
+        assert save_checkpoint(prefix) == 3
+
+        # Wipe by negating (the reference LogReg uploads loaded models with
+        # a negate-add trick, ref: ps_model.cpp:116-169 — here we just
+        # overwrite and restore).
+        arr.add(-2 * np.arange(50, dtype=np.float32))
+        assert load_checkpoint(prefix) == 3
+        np.testing.assert_array_equal(arr.get(),
+                                      np.arange(50, dtype=np.float32))
+        np.testing.assert_array_equal(mat.get_rows(np.array([3], np.int32)),
+                                      np.ones((1, 4), np.float32))
+        assert kv.get([9])[9] == pytest.approx(4.5)

@@ -175,6 +175,41 @@ class TestDonationSafety:
         assert float(table._dest[0]) in {0.0, 1.0, 2.0, 3.0, 4.0}
 
 
+class TestDeviceResidentPath:
+    def test_array_device_add_get(self, env):
+        import jax.numpy as jnp
+        table = mv.create_array_table(64)
+        delta = jnp.ones(64, jnp.float32)
+        table.add(delta)  # device delta, no host roundtrip
+        out = table.get_device()
+        assert hasattr(out, "addressable_shards")
+        np.testing.assert_array_equal(np.asarray(out), np.ones(64))
+        # host path still agrees
+        np.testing.assert_array_equal(table.get(), np.ones(64))
+
+    def test_matrix_device_add_get(self, env):
+        import jax.numpy as jnp
+        table = mv.create_matrix_table(16, 4)
+        table.add(jnp.full((16, 4), 2.0, jnp.float32))
+        out = table.get_device()
+        assert out.shape == (16, 4)
+        np.testing.assert_array_equal(np.asarray(out), np.full((16, 4), 2.0))
+
+    def test_device_path_multi_server(self):
+        def body(rank):
+            import jax.numpy as jnp
+            table = mv.create_array_table(32)
+            if rank == 0:
+                table.add(jnp.ones(32, jnp.float32))
+            mv.current_zoo().barrier()
+            out = np.asarray(table.get_device())
+            mv.current_zoo().barrier()
+            return out.tolist()
+
+        r0, r1 = LocalCluster(2).run(body)
+        assert r0 == r1 == [1.0] * 32
+
+
 class TestKVTable:
     def test_add_get(self, env):
         table = mv.create_kv_table()
