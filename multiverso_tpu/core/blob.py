@@ -72,9 +72,13 @@ class Blob:
 
     @property
     def size(self) -> int:
-        """Size in bytes (the reference's ``size()``)."""
-        arr = self._host()
-        return arr.nbytes
+        """Size in bytes (the reference's ``size()``). Computed from
+        shape/dtype for device payloads — materializing here would silently
+        defeat the zero-copy device path."""
+        if self.on_device:
+            return int(np.prod(self._data.shape)) \
+                * np.dtype(self._data.dtype).itemsize
+        return self._host().nbytes
 
     def count(self, dtype=np.float32) -> int:
         """Element count under a typed view (the reference's ``size<T>()``)."""

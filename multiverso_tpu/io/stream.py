@@ -108,7 +108,8 @@ class TextReader:
             if self._eof:
                 if self._buf:
                     line, self._buf = self._buf, b""
-                    return line.decode("utf-8", errors="replace")
+                    return line.decode("utf-8",
+                                       errors="replace").rstrip("\r")
                 return None
             chunk = self._stream.read(self._buf_size)
             if not chunk:

@@ -1,0 +1,1 @@
+"""Applications (the reference's Applications/ directory)."""

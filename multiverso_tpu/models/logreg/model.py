@@ -1,0 +1,275 @@
+"""LogReg models: local, parameter-server, and FTRL.
+
+TPU-native re-design of the reference's model layer
+(ref: Applications/LogisticRegression/src/model/model.cpp,
+model/ps_model.cpp). The per-sample gradient loop + separate updater pass
+collapse into ONE jitted train step per minibatch (forward, backward,
+update fused on device); the PS variant keeps the reference's structure —
+pull every ``sync_frequency`` minibatches with double-buffered async gets
+(ref: ps_model.cpp:236-271), push lr-scaled deltas (ref: ps_model.cpp:
+185-203, updater.cpp:55-70) — but both directions ride the device-resident
+table path, so model bytes never touch the host.
+
+FTRL-proximal (ref: updater/ftrl_updater.h, util/ftrl_sparse_table.h)
+keeps per-weight state z (signed accumulator) and n (squared-gradient sum);
+the PS form pushes (delta_z, delta_n) to two tables with the default adder,
+matching the reference's FTRL gradient wire format {delta_z, delta_n}
+(ref: util/data_type.h:13-54).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import create_array_table, create_matrix_table
+from ...updater.engine import pad_ids
+from ...util import log
+from .config import Configure
+from .objective import (learning_rate, make_dense_step, make_predict,
+                        make_sparse_step)
+from .reader import Batch
+
+
+def _weight_shape(config: Configure):
+    rows = config.input_size + (1 if config.sparse else 0)
+    return (rows, max(config.output_size, 1))
+
+
+class LocalModel:
+    """Single-process model: weights live on device, one jit per batch
+    (ref: model/model.cpp:63-110)."""
+
+    def __init__(self, config: Configure):
+        self.config = config
+        self._w = jnp.zeros(_weight_shape(config), jnp.float32)
+        step = make_sparse_step(config) if config.sparse \
+            else make_dense_step(config)
+        scale_lr = config.updater_type in ("sgd", "ftrl")
+
+        def fused(w, lr, *batch_args):
+            loss_sum, correct, grad = step(w, *batch_args)
+            delta = grad * lr if scale_lr else grad
+            return w - delta, loss_sum, correct
+
+        self._step = jax.jit(fused, donate_argnums=(0,))
+        self._predict = make_predict(config)
+        self.update_count = 0
+
+    def update(self, batch: Batch) -> float:
+        lr = jnp.float32(learning_rate(self.config, self.update_count))
+        self._w, loss_sum, _ = self._step(self._w, lr, *_args(batch))
+        self.update_count += 1
+        return float(loss_sum)
+
+    def predict(self, batch: Batch) -> np.ndarray:
+        return np.asarray(self._predict(self._w, *_args(batch)[:-2]))
+
+    @property
+    def weights(self) -> np.ndarray:
+        return np.asarray(self._w)
+
+    def load_weights(self, w: np.ndarray) -> None:
+        self._w = jnp.asarray(w, jnp.float32).reshape(self._w.shape)
+
+    def store(self, stream) -> None:
+        stream.write(self.weights.astype(np.float32).tobytes())
+
+    def load(self, stream) -> None:
+        shape = _weight_shape(self.config)
+        raw = stream.read(int(np.prod(shape)) * 4)
+        self.load_weights(np.frombuffer(raw, np.float32).reshape(shape))
+
+
+def _args(batch: Batch):
+    if batch.x is not None:
+        return (jnp.asarray(batch.x), jnp.asarray(batch.labels),
+                jnp.asarray(batch.weights))
+    return (jnp.asarray(batch.keys), jnp.asarray(batch.values),
+            jnp.asarray(batch.labels), jnp.asarray(batch.weights))
+
+
+class PSModel:
+    """Parameter-server model (ref: model/ps_model.cpp:23-271).
+
+    Dense: whole model in one ArrayTable with the sgd server updater;
+    pulls ride ``get_device`` (HBM to HBM) and pushes are device deltas, so
+    model bytes never touch the host. Sparse: row-sharded sparse
+    MatrixTable whose pulls return only this worker's dirty rows. Pulls
+    happen every ``sync_frequency`` minibatches; meanwhile the worker
+    trains on its local replica and pushes lr-scaled deltas that the
+    server's sgd updater subtracts (ref: ps_model.cpp:172-203,
+    sgd_updater.h:15-19).
+    """
+
+    def __init__(self, config: Configure):
+        self.config = config
+        rows, cols = _weight_shape(config)
+        self._w = jnp.zeros((rows, cols), jnp.float32)
+        if config.sparse:
+            self._table = create_matrix_table(
+                rows, cols, is_sparse=True, is_pipeline=config.pipeline,
+                updater_type="sgd")
+        else:
+            self._table = create_array_table(rows * cols,
+                                             updater_type="sgd")
+        self._objective_step = make_sparse_step(config) if config.sparse \
+            else make_dense_step(config)
+        scale_lr = config.updater_type in ("sgd", "ftrl")
+        self._scale = jax.jit(lambda g, lr: g * lr if scale_lr else g)
+        self._apply_local = jax.jit(lambda w, d: w - d,
+                                    donate_argnums=(0,))
+        self._gather_rows = jax.jit(
+            lambda d, r: d.at[r].get(mode="fill", fill_value=0))
+        self._predict = make_predict(config)
+        self.update_count = 0
+        self._batch_count = 0
+        self._pull()
+
+    # -- pull (ref: ps_model.cpp:172-182) --
+    def _pull(self) -> None:
+        if self.config.sparse:
+            buf = np.asarray(self._w)  # dirty rows overwrite in place
+            self._table.get(out=buf)
+            self._w = jnp.asarray(buf)
+        else:
+            self._w = self._table.get_device().reshape(self._w.shape)
+
+    def update(self, batch: Batch) -> float:
+        config = self.config
+        lr = jnp.float32(learning_rate(config, self.update_count))
+        loss_sum, _, grad = self._objective_step(self._w, *_args(batch))
+        delta = self._scale(grad, lr)
+        if config.sparse:
+            touched = np.unique(batch.keys.reshape(-1))
+            touched = touched[touched < config.input_size].astype(np.int32)
+            rows = pad_ids(touched, config.input_size + 1)
+            row_delta = np.asarray(self._gather_rows(delta, rows))
+            self._table.add_rows_async(touched, row_delta[:touched.size])
+        else:
+            self._table.add_async(delta.reshape(-1))
+        # Apply locally too so training continues between pulls.
+        self._w = self._apply_local(self._w, delta)
+        self.update_count += 1
+        self._batch_count += 1
+        if self._batch_count % config.sync_frequency == 0:
+            self._pull()
+        return float(loss_sum)
+
+    def predict(self, batch: Batch) -> np.ndarray:
+        return np.asarray(self._predict(self._w, *_args(batch)[:-2]))
+
+    @property
+    def weights(self) -> np.ndarray:
+        return np.asarray(self._w)
+
+    def store(self, stream) -> None:
+        stream.write(self.weights.astype(np.float32).tobytes())
+
+    def load(self, stream) -> None:
+        shape = _weight_shape(self.config)
+        raw = stream.read(int(np.prod(shape)) * 4)
+        loaded = np.frombuffer(raw, np.float32).reshape(shape)
+        # Upload into the PS with the negate-add trick: push (current -
+        # loaded) through the subtracting sgd updater
+        # (ref: ps_model.cpp:116-169).
+        self._pull()
+        delta = (np.asarray(self._w) - loaded)
+        if self.config.sparse:
+            rows = np.arange(shape[0], dtype=np.int32)
+            self._table.add_rows(rows, delta)
+        else:
+            self._table.add(delta.reshape(-1))
+        self._pull()
+
+
+class FTRLModel:
+    """FTRL-proximal (ref: updater/ftrl_updater.h semantics): per-weight
+    state z, n; w derived lazily:
+        w = 0                                  if |z| <= lambda1
+        w = -(z - sign(z)*lambda1) / ((beta + sqrt(n))/alpha + lambda2)
+    update: g = grad; sigma = (sqrt(n + g^2) - sqrt(n)) / alpha;
+            z += g - sigma*w ; n += g^2.
+    """
+
+    def __init__(self, config: Configure, use_ps: bool = False):
+        self.config = config
+        shape = _weight_shape(config)
+        self._z = jnp.zeros(shape, jnp.float32)
+        self._n = jnp.zeros(shape, jnp.float32)
+        step = make_sparse_step(config) if config.sparse \
+            else make_dense_step(config)
+        alpha, beta = config.alpha, config.beta
+        l1, l2 = config.lambda1, config.lambda2
+
+        def weights_of(z, n):
+            shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - l1, 0.0)
+            return -shrunk / ((beta + jnp.sqrt(n)) / alpha + l2)
+
+        def fused(z, n, *batch_args):
+            w = weights_of(z, n)
+            loss_sum, correct, g = step(w, *batch_args)
+            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
+            return z + (g - sigma * w), n + g * g, loss_sum, correct, \
+                g, sigma * w
+
+        self._fused = jax.jit(fused, donate_argnums=(0, 1))
+        self._weights_of = jax.jit(weights_of)
+        self._predict = make_predict(config)
+        self.update_count = 0
+        self._use_ps = use_ps
+        if use_ps:
+            size = int(np.prod(shape))
+            self._z_table = create_array_table(size)  # default adder
+            self._n_table = create_array_table(size)
+            self._batch_count = 0
+
+    def update(self, batch: Batch) -> float:
+        old_z, old_n = self._z, self._n
+        self._z, self._n, loss_sum, _, g, sigma_w = \
+            self._fused(old_z, old_n, *_args(batch))
+        if self._use_ps:
+            # Push the FTRL gradient pair {delta_z, delta_n}
+            # (ref: util/data_type.h:13-54).
+            self._z_table.add_async((g - sigma_w).reshape(-1))
+            self._n_table.add_async((g * g).reshape(-1))
+            self._batch_count += 1
+            if self._batch_count % self.config.sync_frequency == 0:
+                shape = self._z.shape
+                self._z = self._z_table.get_device().reshape(shape)
+                self._n = self._n_table.get_device().reshape(shape)
+        self.update_count += 1
+        return float(loss_sum)
+
+    def predict(self, batch: Batch) -> np.ndarray:
+        w = self._weights_of(self._z, self._n)
+        return np.asarray(self._predict(w, *_args(batch)[:-2]))
+
+    @property
+    def weights(self) -> np.ndarray:
+        return np.asarray(self._weights_of(self._z, self._n))
+
+    def store(self, stream) -> None:
+        stream.write(np.asarray(self._z).tobytes())
+        stream.write(np.asarray(self._n).tobytes())
+
+    def load(self, stream) -> None:
+        shape = _weight_shape(self.config)
+        count = int(np.prod(shape)) * 4
+        self._z = jnp.asarray(
+            np.frombuffer(stream.read(count), np.float32).reshape(shape))
+        self._n = jnp.asarray(
+            np.frombuffer(stream.read(count), np.float32).reshape(shape))
+
+
+def create_model(config: Configure):
+    """Factory (ref: model.cpp Model::Get / main.cpp flow)."""
+    if config.objective_type == "ftrl" or config.updater_type == "ftrl":
+        return FTRLModel(config, use_ps=config.use_ps)
+    if config.use_ps:
+        return PSModel(config)
+    return LocalModel(config)

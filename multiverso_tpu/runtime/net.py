@@ -61,8 +61,13 @@ class NetInterface:
 
     def allreduce(self, array: "np.ndarray") -> "np.ndarray":
         """Sum-allreduce a host array across ranks (the transport-level
-        collective behind MV_Aggregate, ref: mpi_net.h:147-151)."""
-        raise NotImplementedError
+        collective behind MV_Aggregate, ref: mpi_net.h:147-151). The
+        default drives the AllreduceEngine over this endpoint's raw
+        send/recv (ma mode only — the PS actors must not own the endpoint);
+        transports with a native collective override this (LocalNet uses
+        shared memory, an MPI-like transport would use its own)."""
+        from .allreduce_engine import AllreduceEngine
+        return AllreduceEngine(self).allreduce(array)
 
     @property
     def name(self) -> str:

@@ -53,7 +53,11 @@ class ArrayWorker(WorkerTable):
         self.dtype = np.dtype(dtype)
         self._num_server = self._zoo.num_servers
         self._offsets = server_offsets(self.size, self._num_server)
+        # One outstanding Get per table, same as the reference's shared
+        # row_index_/data_ destination registers (ref: matrix_table.cpp:
+        # 66-76). _dest xor _device_shards names the reply destination.
         self._dest: Optional[np.ndarray] = None
+        self._device_shards: Optional[Dict[int, object]] = None
 
     # -- public API (ref: array_table.cpp:29-66) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -64,7 +68,7 @@ class ArrayWorker(WorkerTable):
         if out is None:
             out = np.empty(self.size, self.dtype)
         CHECK(out.size == self.size, "output buffer size mismatch")
-        self._dest = out
+        self._dest, self._device_shards = out, None
         return self.get_async_raw(Blob(_ALL_KEY.view(np.uint8)))
 
     def add(self, delta: np.ndarray,
@@ -103,8 +107,7 @@ class ArrayWorker(WorkerTable):
     def get_device(self):
         """Whole-table Get returning a device array (no host transfer).
         The reply shards are the servers' jitted snapshots in HBM."""
-        self._dest = None
-        self._device_shards: Dict[int, object] = {}
+        self._dest, self._device_shards = None, {}
         msg_id = self.get_async_raw(Blob(_ALL_KEY.view(np.uint8)))
         self.wait(msg_id)
         shards = [self._device_shards[sid]
@@ -118,9 +121,12 @@ class ArrayWorker(WorkerTable):
     # -- reply (ref: array_table.cpp:95-106) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
         server_id = int(reply_blobs[0].as_array(np.int32)[0])
-        if self._dest is None:  # device-resident get
+        if self._device_shards is not None:  # device-resident get
             self._device_shards[server_id] = reply_blobs[1].typed(self.dtype)
             return
+        CHECK(self._dest is not None,
+              "Get reply with no outstanding destination — only one Get "
+              "may be in flight per table (as in the reference)")
         values = reply_blobs[1].as_array(self.dtype)
         lo, hi = self._offsets[server_id], self._offsets[server_id + 1]
         CHECK(values.size == hi - lo, "reply shard size mismatch")

@@ -93,8 +93,12 @@ class MatrixWorker(WorkerTable):
         self._offsets = row_offsets(self.num_row, self._zoo.num_servers)
         self._num_server = len(self._offsets) - 1  # actual servers used
         self._row_length = max(self.num_row // self._num_server, 1)
+        # One outstanding Get per table (the reference's shared row_index_
+        # registers, ref: matrix_table.cpp:66-76). _dest xor _device_shards
+        # names the reply destination.
         self._dest: Optional[np.ndarray] = None
         self._dest_rows: Optional[Dict[int, int]] = None
+        self._device_shards: Optional[Dict[int, object]] = None
 
     # -- Get API (ref: matrix_table.cpp:58-105) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -105,7 +109,7 @@ class MatrixWorker(WorkerTable):
         if out is None:
             out = np.empty((self.num_row, self.num_col), self.dtype)
         CHECK(out.shape == (self.num_row, self.num_col), "bad output shape")
-        self._dest, self._dest_rows = out, None
+        self._dest, self._dest_rows, self._device_shards = out, None, None
         return self._request_get(Blob(_ALL_KEY.view(np.uint8)))
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None
@@ -121,6 +125,7 @@ class MatrixWorker(WorkerTable):
         CHECK(out.shape == (row_ids.size, self.num_col), "bad output shape")
         self._dest = out
         self._dest_rows = {int(r): i for i, r in enumerate(row_ids)}
+        self._device_shards = None
         return self._request_get(Blob(row_ids.view(np.uint8)))
 
     def _request_get(self, keys: Blob) -> int:
@@ -205,8 +210,7 @@ class MatrixWorker(WorkerTable):
     def get_device(self):
         CHECK(not self.is_sparse,
               "device get is for dense tables (sparse replies are ragged)")
-        self._dest, self._dest_rows = None, None
-        self._device_shards: Dict[int, object] = {}
+        self._dest, self._dest_rows, self._device_shards = None, None, {}
         self.wait(self._request_get(Blob(_ALL_KEY.view(np.uint8))))
         shards = [self._device_shards[sid]
                   for sid in range(len(self._device_shards))]
@@ -221,10 +225,13 @@ class MatrixWorker(WorkerTable):
         keys = reply_blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
             server_id = int(reply_blobs[2].as_array(np.int32)[0])
-            if self._dest is None:  # device-resident get
+            if self._device_shards is not None:  # device-resident get
                 self._device_shards[server_id] = \
                     reply_blobs[1].typed(self.dtype)
                 return
+            CHECK(self._dest is not None,
+                  "Get reply with no outstanding destination — only one "
+                  "Get may be in flight per table (as in the reference)")
             lo, hi = self._offsets[server_id], self._offsets[server_id + 1]
             values = reply_blobs[1].as_array(self.dtype)
             self._dest[lo:hi] = values.reshape(hi - lo, self.num_col)

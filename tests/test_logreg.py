@@ -1,0 +1,230 @@
+"""LogisticRegression application tests.
+
+Mirrors the reference's mnist example flow
+(ref: Applications/LogisticRegression/example/mnist.config, src/logreg.cpp)
+on synthetic data: dense softmax, sparse sigmoid, FTRL, local + PS models,
+reader formats, and the end-to-end CLI.
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg import (Configure, FTRLModel, LocalModel,
+                                          PSModel, create_model,
+                                          iter_samples, make_batches,
+                                          parse_text_line)
+from multiverso_tpu.models.logreg.main import LogReg
+
+
+def write_dense_data(path, n=400, d=8, classes=3, seed=0):
+    """Linearly separable synthetic set. Class centers come from a fixed
+    seed so train/test splits with different sample seeds share the same
+    distribution."""
+    rng = np.random.default_rng(seed)
+    centers = np.random.default_rng(42).standard_normal((classes, d)) * 3
+    lines = []
+    for _ in range(n):
+        label = rng.integers(0, classes)
+        x = centers[label] + rng.standard_normal(d) * 0.3
+        lines.append(str(label) + " " + " ".join(f"{v:.5f}" for v in x))
+    path.write_text("\n".join(lines))
+
+
+def write_sparse_data(path, n=300, d=50, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.standard_normal(d)
+    lines = []
+    for _ in range(n):
+        nnz = rng.integers(3, 8)
+        keys = np.sort(rng.choice(d, nnz, replace=False))
+        vals = rng.standard_normal(nnz)
+        label = int(w_true[keys] @ vals > 0)
+        lines.append(f"{label} " + " ".join(
+            f"{k}:{v:.5f}" for k, v in zip(keys, vals)))
+    path.write_text("\n".join(lines))
+
+
+def accuracy(model, config, path):
+    correct = total = 0
+    for batch in make_batches(config, iter_samples(config, str(path))):
+        pred = model.predict(batch)[:batch.count]
+        labels = batch.labels[:batch.count]
+        if pred.shape[1] == 1:
+            hits = (pred[:, 0] >= 0.5).astype(np.int32) == labels
+        else:
+            hits = pred.argmax(axis=1).astype(np.int32) == labels
+        correct += int(hits.sum())
+        total += batch.count
+    return correct / total
+
+
+class TestReader:
+    def test_parse_dense(self):
+        s = parse_text_line("2 0.5 -1.0 3.25", sparse=False, weighted=False)
+        assert s.label == 2 and s.weight == 1.0
+        np.testing.assert_allclose(s.values, [0.5, -1.0, 3.25])
+
+    def test_parse_sparse_libsvm(self):
+        s = parse_text_line("1 3:0.5 17:2.0", sparse=True, weighted=False)
+        assert s.label == 1
+        np.testing.assert_array_equal(s.keys, [3, 17])
+        np.testing.assert_allclose(s.values, [0.5, 2.0])
+
+    def test_parse_weighted(self):
+        s = parse_text_line("1:0.25 1.0 2.0", sparse=False, weighted=True)
+        assert s.label == 1 and s.weight == 0.25
+
+    def test_batching_pads_fixed_shapes(self, tmp_path):
+        path = tmp_path / "d.txt"
+        write_dense_data(path, n=25, d=4, classes=2)
+        config = Configure(input_size=4, output_size=2, minibatch_size=10)
+        config.train_file = str(path)
+        batches = list(make_batches(config,
+                                    iter_samples(config, str(path))))
+        assert [b.count for b in batches] == [10, 10, 5]
+        assert all(b.x.shape == (10, 4) for b in batches)
+        assert batches[-1].weights[5:].sum() == 0  # padding rows weigh 0
+
+    def test_sparse_batch_padding(self, tmp_path):
+        path = tmp_path / "s.txt"
+        write_sparse_data(path, n=12, d=30)
+        config = Configure(input_size=30, output_size=1, sparse=True,
+                           minibatch_size=6)
+        batches = list(make_batches(config,
+                                    iter_samples(config, str(path))))
+        for b in batches:
+            assert b.keys.shape == b.values.shape
+            assert (b.keys <= 30).all()  # padding key == input_size
+
+
+class TestLocalModel:
+    def test_dense_softmax_learns(self, tmp_path):
+        path = tmp_path / "train.txt"
+        write_dense_data(path, n=600, d=8, classes=3)
+        config = Configure(input_size=8, output_size=3,
+                           objective_type="softmax", updater_type="sgd",
+                           learning_rate=0.5, minibatch_size=20,
+                           regular_type="L2", regular_coef=1e-4)
+        model = LocalModel(config)
+        for _ in range(4):
+            for batch in make_batches(config,
+                                      iter_samples(config, str(path))):
+                model.update(batch)
+        assert accuracy(model, config, path) > 0.95
+
+    def test_sparse_sigmoid_learns(self, tmp_path):
+        path = tmp_path / "train.txt"
+        write_sparse_data(path, n=400, d=50)
+        config = Configure(input_size=50, output_size=1, sparse=True,
+                           objective_type="sigmoid", updater_type="sgd",
+                           learning_rate=0.5, minibatch_size=16)
+        model = LocalModel(config)
+        for _ in range(6):
+            for batch in make_batches(config,
+                                      iter_samples(config, str(path))):
+                model.update(batch)
+        assert accuracy(model, config, path) > 0.9
+
+    def test_ftrl_learns(self, tmp_path):
+        path = tmp_path / "train.txt"
+        write_sparse_data(path, n=400, d=50)
+        config = Configure(input_size=50, output_size=1, sparse=True,
+                           objective_type="sigmoid", updater_type="ftrl",
+                           alpha=0.1, beta=1.0, lambda1=0.01, lambda2=0.01,
+                           minibatch_size=16)
+        model = FTRLModel(config)
+        for _ in range(6):
+            for batch in make_batches(config,
+                                      iter_samples(config, str(path))):
+                model.update(batch)
+        assert accuracy(model, config, path) > 0.9
+
+
+class TestPSModel:
+    def test_dense_ps_learns(self, tmp_path):
+        path = tmp_path / "train.txt"
+        write_dense_data(path, n=600, d=8, classes=3)
+        mv.init([])
+        try:
+            config = Configure(input_size=8, output_size=3, use_ps=True,
+                               objective_type="softmax", updater_type="sgd",
+                               learning_rate=0.5, minibatch_size=20,
+                               sync_frequency=2)
+            model = PSModel(config)
+            for _ in range(4):
+                for batch in make_batches(config,
+                                          iter_samples(config, str(path))):
+                    model.update(batch)
+            assert accuracy(model, config, path) > 0.95
+        finally:
+            mv.shutdown()
+
+    def test_sparse_ps_learns(self, tmp_path):
+        path = tmp_path / "train.txt"
+        write_sparse_data(path, n=300, d=40)
+        mv.init([])
+        try:
+            config = Configure(input_size=40, output_size=1, use_ps=True,
+                               sparse=True, objective_type="sigmoid",
+                               updater_type="sgd", learning_rate=0.5,
+                               minibatch_size=16, sync_frequency=1)
+            model = PSModel(config)
+            for _ in range(6):
+                for batch in make_batches(config,
+                                          iter_samples(config, str(path))):
+                    model.update(batch)
+            assert accuracy(model, config, path) > 0.85
+        finally:
+            mv.shutdown()
+
+
+class TestEndToEnd:
+    def test_cli_config_flow(self, tmp_path):
+        # The reference mnist.config flow on synthetic data.
+        train, test = tmp_path / "train.data", tmp_path / "test.data"
+        write_dense_data(train, n=500, d=8, classes=3, seed=1)
+        write_dense_data(test, n=100, d=8, classes=3, seed=2)
+        config_file = tmp_path / "syn.config"
+        config_file.write_text(f"""
+input_size=8
+output_size=3
+objective_type=softmax
+regular_type=L2
+updater_type=sgd
+train_epoch=4
+sparse=false
+use_ps=false
+minibatch_size=20
+train_file={train}
+test_file={test}
+output_file={tmp_path}/test.out
+output_model_file={tmp_path}/model.bin
+learning_rate=0.5
+regular_coef=0.0007
+""")
+        app = LogReg(str(config_file))
+        app.train()
+        acc = app.test()
+        app.close()
+        assert acc > 0.9
+        assert (tmp_path / "model.bin").exists()
+        out_lines = (tmp_path / "test.out").read_text().strip().split("\n")
+        assert len(out_lines) == 100
+
+    def test_model_save_load_roundtrip(self, tmp_path):
+        path = tmp_path / "train.txt"
+        write_dense_data(path, n=200, d=6, classes=2)
+        config = Configure(input_size=6, output_size=2,
+                           objective_type="softmax", updater_type="sgd",
+                           learning_rate=0.5)
+        model = LocalModel(config)
+        for batch in make_batches(config, iter_samples(config, str(path))):
+            model.update(batch)
+        from multiverso_tpu.io import StreamFactory
+        with StreamFactory.get_stream(str(tmp_path / "m.bin"), "w") as s:
+            model.store(s)
+        model2 = LocalModel(config)
+        with StreamFactory.get_stream(str(tmp_path / "m.bin"), "r") as s:
+            model2.load(s)
+        np.testing.assert_array_equal(model.weights, model2.weights)
