@@ -8,8 +8,7 @@ reference's map-based parser.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, fields
-from typing import Optional
+from dataclasses import dataclass
 
 from ...io import TextReader
 from ...util import log
@@ -48,7 +47,6 @@ class Configure:
     @classmethod
     def from_file(cls, path: str) -> "Configure":
         config = cls()
-        typed = {f.name: f.type for f in fields(cls)}
         reader = TextReader(path)
         while True:
             line = reader.get_line()

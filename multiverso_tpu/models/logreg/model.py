@@ -133,7 +133,9 @@ class PSModel:
     # -- pull (ref: ps_model.cpp:172-182) --
     def _pull(self) -> None:
         if self.config.sparse:
-            buf = np.asarray(self._w)  # dirty rows overwrite in place
+            # Writable copy — np.asarray of a jax array is read-only and
+            # the reply handler assigns dirty rows into it.
+            buf = np.array(self._w)
             self._table.get(out=buf)
             self._w = jnp.asarray(buf)
         else:

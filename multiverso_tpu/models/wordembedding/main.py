@@ -1,0 +1,105 @@
+"""WordEmbedding CLI: distributed word2vec trainer.
+
+ref: Applications/WordEmbedding/src/main.cpp:16-28 and
+distributed_wordembedding.cpp (epoch loop over blocks with a loader
+thread; rank 0 saves embeddings after the last epoch). Flags use the
+framework's -key=value convention, mirroring the reference's argv names.
+
+Usage::
+
+    python -m multiverso_tpu.models.wordembedding.main \
+        -train_file=corpus.txt -output_file=vectors.txt -size=100 \
+        -window=5 -negative=5 -epoch=1 [-cbow=true] [-hs=true] \
+        [-use_ps=true] [-min_count=5] [-sample=1e-3] [-batch_size=4096]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ... import init as mv_init, shutdown as mv_shutdown
+from ...util import log
+from ...util.configure import (define_bool, define_double, define_int,
+                               define_string, get_flag, parse_cmd_flags)
+from .data import BlockLoader, TokenizedCorpus, iter_pair_batches
+from .dictionary import Dictionary
+from .model import PSWord2Vec, Word2Vec, Word2VecConfig
+
+define_string("train_file", "", "training corpus (';'-separated)")
+define_string("output_file", "vectors.txt", "embedding output path")
+define_string("vocab_file", "", "optional prebuilt vocab to load")
+define_int("size", 100, "embedding dimension")
+define_int("window", 5, "max context window")
+define_int("negative", 5, "negative samples (0 with -hs)")
+define_int("epoch", 1, "training epochs")
+define_int("min_count", 5, "discard words rarer than this")
+define_double("sample", 1e-3, "subsampling threshold")
+define_double("init_learning_rate", 0.025, "initial learning rate")
+define_bool("cbow", False, "CBOW instead of skip-gram")
+define_bool("hs", False, "hierarchical softmax instead of negative "
+                         "sampling")
+define_bool("use_ps", False, "train through the parameter server")
+define_int("batch_size", 4096, "pairs per jitted step")
+define_bool("is_pipeline", True, "overlap loading with training")
+
+
+def run(argv=None) -> Word2Vec:
+    parse_cmd_flags(list(argv) if argv is not None else sys.argv[1:])
+    config = Word2VecConfig(
+        embedding_size=get_flag("size"), window=get_flag("window"),
+        negative=get_flag("negative"), epochs=get_flag("epoch"),
+        min_count=get_flag("min_count"), sample=get_flag("sample"),
+        init_learning_rate=get_flag("init_learning_rate"),
+        cbow=get_flag("cbow"), hs=get_flag("hs"),
+        batch_size=get_flag("batch_size"), use_ps=get_flag("use_ps"))
+    train_file = get_flag("train_file")
+    if not train_file:
+        raise SystemExit("need -train_file=<corpus>")
+
+    if get_flag("vocab_file"):
+        dictionary = Dictionary.load(get_flag("vocab_file"))
+    else:
+        dictionary = Dictionary.build(train_file,
+                                      min_count=config.min_count)
+    log.info("vocab: %d words, %d tokens", dictionary.size,
+             dictionary.total_count)
+
+    if config.use_ps:
+        mv_init([])
+        model: Word2Vec = PSWord2Vec(config, dictionary)
+    else:
+        model = Word2Vec(config, dictionary)
+
+    corpus = TokenizedCorpus.build(dictionary, train_file)
+    start = time.perf_counter()
+    for epoch in range(config.epochs):
+        batches = iter_pair_batches(
+            dictionary, corpus, batch_size=config.batch_size,
+            window=config.window, subsample=config.sample,
+            cbow=config.cbow, seed=config.seed + epoch)
+        iterator = BlockLoader(batches) if get_flag("is_pipeline") \
+            else batches
+        # Async hot loop: device losses accumulate without host syncs; one
+        # materialization per epoch.
+        pair_count = 0
+        losses = []
+        for batch in iterator:
+            losses.append(model.train_batch_async(batch))
+            pair_count += batch.count
+        loss_sum = sum(float(loss) for loss in losses)
+        elapsed = time.perf_counter() - start
+        log.info("epoch %d: avg pair loss %.4f, %.0f words/s", epoch,
+                 loss_sum / max(pair_count, 1),
+                 model.trained_words / max(elapsed, 1e-9))
+
+    should_save = not config.use_ps or model._in_table.zoo.rank == 0
+    if should_save and get_flag("output_file"):
+        model.save_embeddings(get_flag("output_file"))
+    if config.use_ps:
+        mv_shutdown()
+    return model
+
+
+if __name__ == "__main__":
+    run()

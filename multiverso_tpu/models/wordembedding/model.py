@@ -1,0 +1,295 @@
+"""Word2vec models: SGNS + hierarchical softmax, skip-gram + CBOW.
+
+TPU-native re-design of the reference's WordEmbedding compute core
+(ref: Applications/WordEmbedding/src/wordembedding.cpp — per-window scalar
+FeedForward/BPOutputLayer loops): here one jitted step trains a whole
+batch of (center, context) pairs on the MXU —
+
+- negative sampling (SGNS): negatives are drawn inside the jit by
+  inverse-CDF over the unigram^0.75 distribution; logits are a gathered
+  batched dot product ``einsum('bd,bkd->bk')`` over [positive, K
+  negatives]; gradients scatter-add into both embedding matrices;
+- hierarchical softmax: each pair trains the Huffman path of the context
+  word — codes/points are gathered from device-resident [V, L] tables
+  (built by huffman.py) and padded path slots are masked;
+- CBOW averages the (padded, masked) context window into the input vector
+  and scatters the input gradient back to every window word.
+
+Embeddings are plain device arrays locally; the PS variant keeps them in
+row-sharded matrix tables and trains blocks on pulled rows, pushing
+``(new - old) / num_workers`` exactly like the reference's
+AddDeltaParameter (ref: communicator.cpp:157-249).
+
+The learning rate decays linearly in processed words:
+``lr = initial * max(1 - done/total, 1e-4)`` (ref:
+distributed_wordembedding.cpp:92-134 recomputes it from the global word
+count; in distributed mode that count lives in a KV table).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import create_kv_table, create_matrix_table
+from ...updater.engine import pad_ids
+from ...util import log
+from .data import CbowBatch, PairBatch
+from .dictionary import Dictionary
+from .huffman import build_huffman
+
+
+_MAX_EXP = 6.0  # word2vec.c's sigmoid-table range
+
+
+class Word2VecConfig:
+    """Mirror of the reference's CLI options (ref: WordEmbedding
+    src/util.cpp ParseArgs: -size -window -negative -epoch -min_count
+    -sample -init_learning_rate -cbow -hs ...)."""
+
+    def __init__(self, embedding_size: int = 100, window: int = 5,
+                 negative: int = 5, epochs: int = 1, min_count: int = 5,
+                 sample: float = 1e-3, init_learning_rate: float = 0.025,
+                 cbow: bool = False, hs: bool = False,
+                 batch_size: int = 4096, seed: int = 1,
+                 use_ps: bool = False):
+        self.embedding_size = embedding_size
+        self.window = window
+        self.negative = negative
+        self.epochs = epochs
+        self.min_count = min_count
+        self.sample = sample
+        self.init_learning_rate = init_learning_rate
+        self.cbow = cbow
+        self.hs = hs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.use_ps = use_ps
+
+
+class Word2Vec:
+    """Local (single-process) trainer; device-resident embeddings."""
+
+    _DONATE = True  # PS subclass keeps old params to form wire deltas
+
+    def __init__(self, config: Word2VecConfig, dictionary: Dictionary):
+        self.config = config
+        self.dictionary = dictionary
+        vocab, dim = dictionary.size, config.embedding_size
+        rng = np.random.default_rng(config.seed)
+        # ref init: uniform (-0.5/dim, 0.5/dim) input, zeros output.
+        self._emb_in = jnp.asarray(
+            (rng.random((vocab, dim)) - 0.5) / dim, jnp.float32)
+        if config.hs:
+            tree = build_huffman(dictionary.counts)
+            self._codes = jnp.asarray(tree.codes)
+            self._points = jnp.asarray(tree.points)
+            out_rows = max(tree.num_inner_nodes, 1)
+        else:
+            neg = dictionary.negative_table()
+            self._neg_cdf = jnp.asarray(np.cumsum(neg))
+            out_rows = vocab
+        self._emb_out = jnp.zeros((out_rows, dim), jnp.float32)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._step = self._build_step()
+        self.trained_words = 0
+        self.total_words = dictionary.total_count * config.epochs
+
+    # -- learning rate schedule --
+    def learning_rate(self) -> float:
+        remain = max(1.0 - self.trained_words / max(self.total_words, 1),
+                     1e-4)
+        return self.config.init_learning_rate * remain
+
+    # -- the fused train step --
+    def _build_step(self):
+        config = self.config
+        if config.hs:
+            pair_loss = self._hs_pair_loss
+        else:
+            pair_loss = self._neg_pair_loss
+
+        # ``pair_mask`` zeroes the tail-batch padding rows — without it the
+        # padded (0, 0) pairs would train the most frequent word against
+        # itself as a positive example.
+        if config.cbow:
+            def loss_fn(params, window, centers, pair_mask, key):
+                emb_in, emb_out = params
+                mask = (window >= 0).astype(jnp.float32)
+                safe = jnp.maximum(window, 0)
+                vecs = emb_in[safe] * mask[..., None]
+                denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+                v = vecs.sum(axis=1) / denom  # [B, D] averaged window
+                return pair_loss(v, centers, emb_out, pair_mask, key)
+        else:
+            def loss_fn(params, centers, contexts, pair_mask, key):
+                emb_in, emb_out = params
+                v = emb_in[centers]
+                return pair_loss(v, contexts, emb_out, pair_mask, key)
+
+        def step(params, lr, key, pair_mask, *batch):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, *batch, pair_mask, key))(params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+            return new_params, loss
+
+        return jax.jit(step,
+                       donate_argnums=(0,) if self._DONATE else ())
+
+    def _neg_pair_loss(self, v, targets, emb_out, pair_mask, key):
+        """SGNS: positive target + K in-jit sampled negatives."""
+        k = self.config.negative
+        batch = v.shape[0]
+        uniform = jax.random.uniform(key, (batch, k))
+        negatives = jnp.searchsorted(self._neg_cdf, uniform)
+        cols = jnp.concatenate([targets[:, None], negatives], axis=1)
+        u = emb_out[cols]  # [B, 1+K, D]
+        # MAX_EXP clamp, exactly word2vec's sigmoid table: saturated pairs
+        # get ZERO gradient (clip has zero derivative outside the range),
+        # which is what keeps hot rows from diverging under batched sums.
+        logits = jnp.clip(jnp.einsum("bd,bkd->bk", v, u),
+                          -_MAX_EXP, _MAX_EXP)
+        labels = jnp.concatenate(
+            [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
+        losses = _sigmoid_xent(logits, labels) * pair_mask[:, None]
+        # SUM over the batch: word2vec applies the learning rate per pair
+        # (ref trains pair-by-pair); a mean would shrink the per-pair step
+        # by the batch size.
+        return jnp.sum(losses)
+
+    def _hs_pair_loss(self, v, targets, emb_out, pair_mask, key):
+        """Hierarchical softmax over the target's Huffman path."""
+        points = self._points[targets]  # [B, L]
+        codes = self._codes[targets]
+        mask = (codes >= 0).astype(jnp.float32) * pair_mask[:, None]
+        u = emb_out[jnp.maximum(points, 0)]  # [B, L, D]
+        logits = jnp.clip(jnp.einsum("bd,bld->bl", v, u),
+                          -_MAX_EXP, _MAX_EXP)  # word2vec MAX_EXP clamp
+        # code 0 = positive class (sigmoid(logit)), 1 = negative — the
+        # word2vec convention (ref: wordembedding.cpp HS branch).
+        labels = 1.0 - codes.astype(jnp.float32)
+        losses = _sigmoid_xent(logits, labels * mask) * mask
+        return jnp.sum(losses)  # per-pair lr semantics, as in SGNS
+
+    # -- public API --
+    def train_batch_async(self, batch):
+        """Dispatch one training step WITHOUT synchronizing; returns the
+        device scalar loss. The hot loop must not materialize per-batch
+        scalars — a host fetch per step serializes on device/tunnel
+        latency and caps words/sec."""
+        lr = jnp.float32(self.learning_rate())
+        self._key, subkey = jax.random.split(self._key)
+        params = (self._emb_in, self._emb_out)
+        if isinstance(batch, CbowBatch):
+            args = (jnp.asarray(batch.window), jnp.asarray(batch.centers))
+            size = batch.centers.shape[0]
+        else:
+            args = (jnp.asarray(batch.centers), jnp.asarray(batch.contexts))
+            size = batch.centers.shape[0]
+        pair_mask = _full_mask(size) if batch.count == size \
+            else jnp.asarray((np.arange(size) < batch.count)
+                             .astype(np.float32))
+        (self._emb_in, self._emb_out), loss = self._step(
+            params, lr, subkey, pair_mask, *args)
+        self.trained_words += batch.words
+        return loss
+
+    def train_batch(self, batch) -> float:
+        loss = self.train_batch_async(batch)
+        return float(loss) / max(batch.count, 1)  # display per-pair loss
+
+    @property
+    def embeddings(self) -> np.ndarray:
+        return np.asarray(self._emb_in)
+
+    def save_embeddings(self, path: str) -> None:
+        """word2vec text format (ref rank-0 save,
+        distributed_wordembedding.cpp:231-236)."""
+        from ...io import StreamFactory
+        emb = self.embeddings
+        with StreamFactory.get_stream(path, "w") as stream:
+            stream.write(f"{emb.shape[0]} {emb.shape[1]}\n".encode())
+            for word, row in zip(self.dictionary.words, emb):
+                vec = " ".join(f"{x:.6f}" for x in row)
+                stream.write(f"{word} {vec}\n".encode())
+
+
+@functools.lru_cache(maxsize=None)
+def _full_mask(size: int):
+    return jnp.ones((size,), jnp.float32)
+
+
+def _sigmoid_xent(logits, labels):
+    """Numerically stable sigmoid cross-entropy."""
+    return jnp.maximum(logits, 0) - logits * labels \
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+class PSWord2Vec(Word2Vec):
+    """Distributed trainer: embeddings live in row-sharded matrix tables;
+    each batch pulls the rows it touches, trains on device, and pushes
+    ``(new - old) / num_workers`` (ref: communicator.cpp:117-249). The
+    global word count rides a KV table for the lr schedule
+    (ref: communicator.cpp:251-259)."""
+
+    _DONATE = False
+
+    def __init__(self, config: Word2VecConfig, dictionary: Dictionary,
+                 num_workers: int = 1):
+        super().__init__(config, dictionary)
+        vocab, dim = dictionary.size, config.embedding_size
+        out_rows = int(self._emb_out.shape[0])
+        self._in_table = create_matrix_table(vocab, dim,
+                                             updater_type="default")
+        self._out_table = create_matrix_table(out_rows, dim,
+                                              updater_type="default")
+        self._wc_table = create_kv_table()
+        self._num_workers = max(num_workers, 1)
+        # Seed the server with this worker's init (workers after the first
+        # add zeros-delta equivalents; with random per-rank init the model
+        # averages, mirroring the reference's master-init convention).
+        if self._in_table.zoo.worker_id == 0:
+            self._in_table.add(np.asarray(self._emb_in))
+        self._in_table.zoo.barrier()
+        self._pull_full()
+
+    def _pull_full(self) -> None:
+        self._emb_in = self._in_table.get_device().reshape(
+            self._emb_in.shape)
+        self._emb_out = self._out_table.get_device().reshape(
+            self._emb_out.shape)
+
+    def train_batch_async(self, batch):
+        # The PS path must push/pull around every step; there is no
+        # fire-and-forget variant (the pull is the synchronization point).
+        return jnp.float32(self.train_batch(batch))
+
+    def train_batch(self, batch) -> float:
+        old_in, old_out = self._emb_in, self._emb_out
+        # Base-class async step explicitly: self.train_batch_async is the
+        # PS wrapper above and would recurse.
+        loss = float(Word2Vec.train_batch_async(self, batch)) \
+            / max(batch.count, 1)
+        scale = 1.0 / self._num_workers
+        delta_in = np.asarray((self._emb_in - old_in) * scale)
+        delta_out = np.asarray((self._emb_out - old_out) * scale)
+        rows_in = np.unique(np.asarray(
+            batch.centers if not isinstance(batch, CbowBatch)
+            else batch.window)).astype(np.int32)
+        rows_in = rows_in[rows_in >= 0]
+        self._in_table.add_rows_async(rows_in, delta_in[rows_in])
+        rows_out = np.nonzero(np.abs(delta_out).sum(axis=1))[0] \
+            .astype(np.int32)
+        if rows_out.size:
+            self._out_table.add_rows_async(rows_out, delta_out[rows_out])
+        self._wc_table.add([0], [float(batch.words)])
+        # Refresh from the server so other workers' updates land.
+        self._pull_full()
+        global_words = self._wc_table.get([0])[0]
+        self.trained_words = int(global_words)
+        return loss

@@ -160,6 +160,26 @@ class TestPSModel:
         finally:
             mv.shutdown()
 
+    def test_sparse_ps_pull_receives_server_rows(self, tmp_path):
+        # Regression: the sparse pull buffer must be writable — a
+        # read-only np.asarray(jax) destination made every pull a silent
+        # no-op inside the worker actor.
+        mv.init([])
+        try:
+            config = Configure(input_size=10, output_size=1, use_ps=True,
+                               sparse=True, objective_type="sigmoid",
+                               updater_type="sgd")
+            model = PSModel(config)
+            # Another worker's update dirties rows for worker 0.
+            from multiverso_tpu.updater import AddOption
+            model._table.add_rows(np.array([4], np.int32),
+                                  np.full((1, 1), -3.0, np.float32),
+                                  option=AddOption(worker_id=1))
+            model._pull()
+            assert model.weights[4, 0] == pytest.approx(3.0)  # sgd: -=
+        finally:
+            mv.shutdown()
+
     def test_sparse_ps_learns(self, tmp_path):
         path = tmp_path / "train.txt"
         write_sparse_data(path, n=300, d=40)

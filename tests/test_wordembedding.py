@@ -1,0 +1,229 @@
+"""WordEmbedding application tests.
+
+Covers the reference's component behaviors (dictionary/huffman/reader,
+ref: Applications/WordEmbedding/src/) plus end-to-end training quality:
+on a synthetic corpus with two disjoint topic clusters, within-topic
+embedding similarity must exceed cross-topic similarity for every mode
+(SGNS skip-gram, CBOW, hierarchical softmax, PS-backed).
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding import (Dictionary, PSWord2Vec,
+                                                 Word2Vec, Word2VecConfig,
+                                                 build_huffman,
+                                                 iter_pair_batches,
+                                                 sentence_pairs)
+
+
+def write_topic_corpus(path, n_sentences=800, seed=0):
+    """Two topic clusters; words co-occur only within their topic."""
+    rng = np.random.default_rng(seed)
+    topics = [[f"a{i}" for i in range(8)], [f"b{i}" for i in range(8)]]
+    lines = []
+    for _ in range(n_sentences):
+        topic = topics[rng.integers(0, 2)]
+        lines.append(" ".join(rng.choice(topic, size=12)))
+    path.write_text("\n".join(lines))
+
+
+def topic_separation(model, dictionary):
+    emb = model.embeddings
+    emb = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True),
+                           1e-9)
+    ids_a = [dictionary.word2id[w] for w in dictionary.words
+             if w.startswith("a")]
+    ids_b = [dictionary.word2id[w] for w in dictionary.words
+             if w.startswith("b")]
+    sims = emb @ emb.T
+    within = (sims[np.ix_(ids_a, ids_a)].mean()
+              + sims[np.ix_(ids_b, ids_b)].mean()) / 2
+    across = sims[np.ix_(ids_a, ids_b)].mean()
+    return within - across
+
+
+class TestDictionary:
+    def test_build_and_counts(self, tmp_path):
+        path = tmp_path / "c.txt"
+        path.write_text("x x x y y z\nx y q")
+        d = Dictionary.build(str(path), min_count=2)
+        assert d.word2id["x"] == 0  # most frequent first
+        assert set(d.words) == {"x", "y"}
+        assert d.counts[d.word2id["x"]] == 4
+
+    def test_store_load_roundtrip(self, tmp_path):
+        path = tmp_path / "c.txt"
+        path.write_text("x x x y y z z z z")
+        d = Dictionary.build(str(path), min_count=1)
+        d.store(str(tmp_path / "vocab.txt"))
+        d2 = Dictionary.load(str(tmp_path / "vocab.txt"))
+        assert d2.words == d.words
+        np.testing.assert_array_equal(d2.counts, d.counts)
+
+    def test_negative_table_sums_to_one(self, tmp_path):
+        path = tmp_path / "c.txt"
+        path.write_text("x x x y y z")
+        d = Dictionary.build(str(path), min_count=1)
+        table = d.negative_table()
+        assert table.sum() == pytest.approx(1.0, rel=1e-5)
+
+
+class TestHuffman:
+    def test_codes_are_prefix_free(self):
+        counts = np.array([50, 30, 10, 5, 3, 2])
+        tree = build_huffman(counts)
+        codes = []
+        for i in range(len(counts)):
+            length = tree.code_lengths[i]
+            codes.append(tuple(tree.codes[i, :length]))
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert c1 != c2[:len(c1)], "prefix violation"
+
+    def test_frequent_words_get_short_codes(self):
+        counts = np.array([1000, 500, 10, 5, 2, 1, 1, 1])
+        tree = build_huffman(counts)
+        assert tree.code_lengths[0] <= tree.code_lengths[-1]
+
+    def test_inner_node_count(self):
+        tree = build_huffman(np.array([5, 4, 3, 2, 1]))
+        assert tree.num_inner_nodes == 4  # vocab-1 inner nodes
+
+
+class TestPairGeneration:
+    def test_sentence_pairs_within_window(self):
+        rng = np.random.default_rng(0)
+        ids = np.arange(10, dtype=np.int32)
+        pairs = sentence_pairs(ids, window=3, rng=rng)
+        assert pairs.shape[0] == 2
+        assert (pairs[0] != pairs[1]).any()
+        # Every pair must be within the max window.
+        pos = {int(v): i for i, v in enumerate(ids)}
+        for c, t in pairs.T:
+            assert 1 <= abs(pos[int(c)] - pos[int(t)]) <= 3
+
+    def test_batches_have_fixed_shape(self, tmp_path):
+        path = tmp_path / "c.txt"
+        write_topic_corpus(path, n_sentences=50)
+        d = Dictionary.build(str(path), min_count=1)
+        batches = list(iter_pair_batches(d, str(path), batch_size=256,
+                                         window=3, subsample=0))
+        assert all(b.centers.shape == (256,) for b in batches)
+        assert all(b.count <= 256 for b in batches)
+
+    def test_batch_words_sum_to_corpus_tokens(self, tmp_path):
+        # words (the lr-schedule unit) must count corpus words, not pairs
+        # (pairs ~ window x words).
+        path = tmp_path / "c.txt"
+        write_topic_corpus(path, n_sentences=50)
+        d = Dictionary.build(str(path), min_count=1)
+        batches = list(iter_pair_batches(d, str(path), batch_size=256,
+                                         window=3, subsample=0))
+        total_words = sum(b.words for b in batches)
+        total_pairs = sum(b.count for b in batches)
+        assert total_words == pytest.approx(d.total_count, rel=1e-6)
+        assert total_pairs > 2 * total_words  # different units indeed
+
+    def test_tail_padding_pairs_do_not_train(self, tmp_path):
+        # A tail batch's padded (0,0) rows must not push word 0 toward
+        # itself as a positive pair: with every pair masked out, the step
+        # must be an exact no-op.
+        from multiverso_tpu.models.wordembedding.data import PairBatch
+        path = tmp_path / "c.txt"
+        path.write_text("q0 q1 q2 q0 q1 q2\n")
+        d = Dictionary.build(str(path), min_count=1)
+        config = Word2VecConfig(embedding_size=8, window=2, epochs=1,
+                                init_learning_rate=0.1, batch_size=16,
+                                sample=0)
+        model = Word2Vec(config, d)
+        before = np.asarray(model._emb_in).copy()
+        all_padding = PairBatch(np.zeros(16, np.int32),
+                                np.zeros(16, np.int32), count=0, words=0)
+        loss = model.train_batch_async(all_padding)
+        assert float(loss) == 0.0
+        np.testing.assert_array_equal(np.asarray(model._emb_in), before)
+
+
+def train_and_separate(tmp_path, **config_kw):
+    path = tmp_path / "corpus.txt"
+    write_topic_corpus(path)
+    d = Dictionary.build(str(path), min_count=1)
+    # Small lr: batch-summed gradients on this tiny vocab hit each row
+    # ~64x per batch (see model.py on per-pair lr semantics).
+    config = Word2VecConfig(embedding_size=16, window=3, epochs=3,
+                            init_learning_rate=0.01, batch_size=1024,
+                            sample=0, **config_kw)
+    model = Word2Vec(config, d)
+    for epoch in range(config.epochs):
+        for batch in iter_pair_batches(d, str(path), batch_size=1024,
+                                       window=3, subsample=0,
+                                       cbow=config.cbow,
+                                       seed=epoch):
+            model.train_batch(batch)
+    return topic_separation(model, d), model, d
+
+
+class TestTraining:
+    def test_sgns_skipgram_separates_topics(self, tmp_path):
+        sep, _, _ = train_and_separate(tmp_path)
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_cbow_separates_topics(self, tmp_path):
+        sep, _, _ = train_and_separate(tmp_path, cbow=True)
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_hierarchical_softmax_separates_topics(self, tmp_path):
+        sep, _, _ = train_and_separate(tmp_path, hs=True, negative=0)
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_save_embeddings_format(self, tmp_path):
+        _, model, d = train_and_separate(tmp_path)
+        out = tmp_path / "vec.txt"
+        model.save_embeddings(str(out))
+        lines = out.read_text().strip().split("\n")
+        header = lines[0].split()
+        assert int(header[0]) == d.size and int(header[1]) == 16
+        assert len(lines) == d.size + 1
+
+
+class TestPSWord2Vec:
+    def test_ps_training_separates_topics(self, tmp_path):
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        mv.init([])
+        try:
+            config = Word2VecConfig(embedding_size=16, window=3, epochs=3,
+                                    init_learning_rate=0.01,
+                                    batch_size=1024, sample=0, use_ps=True)
+            model = PSWord2Vec(config, d)
+            for epoch in range(config.epochs):
+                for batch in iter_pair_batches(d, str(path),
+                                               batch_size=1024, window=3,
+                                               subsample=0, seed=epoch):
+                    model.train_batch(batch)
+            sep = topic_separation(model, d)
+        finally:
+            mv.shutdown()
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_ps_word_count_drives_lr(self, tmp_path):
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=100)
+        d = Dictionary.build(str(path), min_count=1)
+        mv.init([])
+        try:
+            config = Word2VecConfig(embedding_size=8, window=2, epochs=1,
+                                    batch_size=512, sample=0, use_ps=True)
+            model = PSWord2Vec(config, d)
+            lr0 = model.learning_rate()
+            for batch in iter_pair_batches(d, str(path), batch_size=512,
+                                           window=2, subsample=0):
+                model.train_batch(batch)
+            assert model.trained_words > 0
+            assert model.learning_rate() < lr0
+        finally:
+            mv.shutdown()
