@@ -1,0 +1,14 @@
+"""multiverso: drop-in Python binding for the TPU-native runtime.
+
+Same public surface as the reference binding
+(ref: binding/python/multiverso/__init__.py, api.py, tables.py) — init/
+shutdown/barrier, workers_num/worker_id/server_id, ArrayTableHandler and
+MatrixTableHandler with the master-initialized init_value convention — but
+implemented directly on multiverso_tpu (no ctypes hop: the runtime IS
+Python). Non-Python hosts use the byte-compatible C ABI in
+native/c_api instead.
+"""
+
+from .api import (barrier, init, is_master_worker, server_id, shutdown,  # noqa: F401
+                  worker_id, workers_num)
+from .tables import ArrayTableHandler, MatrixTableHandler, TableHandler  # noqa: F401

@@ -1,0 +1,42 @@
+"""Process-level API (ref: binding/python/multiverso/api.py:12-75)."""
+
+from __future__ import annotations
+
+import multiverso_tpu as _mv
+
+
+def init(sync: bool = False, args: list = None) -> None:
+    """Initialize multiverso. ``sync=True`` creates a BSP sync server —
+    every process must then call add/get in the same order the same number
+    of times, and every get returns identical results (ref api.py:12-34).
+    """
+    argv = list(args or [])
+    if sync:
+        argv.append("-sync=true")
+    _mv.init(argv)
+
+
+def shutdown() -> None:
+    _mv.shutdown()
+
+
+def barrier() -> None:
+    _mv.barrier()
+
+
+def workers_num() -> int:
+    return _mv.num_workers()
+
+
+def worker_id() -> int:
+    return _mv.worker_id()
+
+
+def server_id() -> int:
+    return _mv.server_id()
+
+
+def is_master_worker() -> bool:
+    """The master (worker 0) owns shared initialization
+    (ref: api.py:68-75)."""
+    return worker_id() == 0

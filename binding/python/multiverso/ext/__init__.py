@@ -1,0 +1,5 @@
+"""Framework adapters (the reference's theano_ext/keras_ext/lasagne_ext,
+re-targeted at today's frameworks: generic, torch, and jax pytrees)."""
+
+from .param_manager import (JaxParamManager, MVModelParamManager,  # noqa: F401
+                            SyncEveryN, TorchParamManager)

@@ -1,0 +1,108 @@
+"""Whole-model parameter sync through one ArrayTable.
+
+Functional equivalent of the reference's theano/lasagne/keras param
+managers (ref: binding/python/multiverso/theano_ext/param_manager.py:9-81,
+theano_ext/sharedvar.py:12-50, keras_ext/callbacks.py:8-39): a model's
+parameters are flattened into a single float32 ArrayTable; each sync pushes
+``current - last_synced`` as the delta and pulls the merged latest, which
+implements ASGD model averaging across workers. ``SyncEveryN`` is the
+keras-callback equivalent (sync every N batches).
+
+Adapters: generic (user get/set functions), ``TorchParamManager`` for
+torch modules, ``JaxParamManager`` for jax pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+from .. import api
+from ..tables import ArrayTableHandler
+
+
+class MVModelParamManager:
+    def __init__(self, get_params: Callable[[], Sequence[np.ndarray]],
+                 set_params: Callable[[List[np.ndarray]], None]):
+        self._get = get_params
+        self._set = set_params
+        values = [np.asarray(v, np.float32) for v in self._get()]
+        self._shapes = [v.shape for v in values]
+        self._offsets = np.cumsum([0] + [v.size for v in values])
+        flat = self._flatten(values)
+        self.table = ArrayTableHandler(flat.size, init_value=flat)
+        api.barrier()
+        self._last = self.table.get()
+        self._set(self._unflatten(self._last))
+
+    def _flatten(self, values) -> np.ndarray:
+        return np.concatenate([np.asarray(v, np.float32).reshape(-1)
+                               for v in values])
+
+    def _unflatten(self, flat: np.ndarray) -> List[np.ndarray]:
+        return [flat[self._offsets[i]:self._offsets[i + 1]]
+                .reshape(self._shapes[i]).copy()
+                for i in range(len(self._shapes))]
+
+    def sync_all_param(self) -> None:
+        """Push (current - last synced), pull the merged model
+        (ref: sharedvar.py:26-50)."""
+        current = self._flatten(self._get())
+        self.table.add(current - self._last, sync=True)
+        self._last = self.table.get()
+        self._set(self._unflatten(self._last))
+
+
+class TorchParamManager(MVModelParamManager):
+    """Sync a torch.nn.Module's parameters (the torch/fb.resnet ASGD
+    setup from the reference's Lua binding, re-targeted)."""
+
+    def __init__(self, module):
+        import torch
+
+        def get_params():
+            return [p.detach().cpu().numpy()
+                    for p in module.parameters()]
+
+        def set_params(values):
+            with torch.no_grad():
+                for p, v in zip(module.parameters(), values):
+                    p.copy_(torch.from_numpy(v))
+
+        super().__init__(get_params, set_params)
+
+
+class JaxParamManager(MVModelParamManager):
+    """Sync a jax pytree of parameters held by the caller via a getter
+    returning the pytree and a setter taking the merged pytree."""
+
+    def __init__(self, get_tree: Callable, set_tree: Callable):
+        import jax
+
+        self._treedef = None
+
+        def get_params():
+            leaves, treedef = jax.tree_util.tree_flatten(get_tree())
+            self._treedef = treedef
+            return [np.asarray(leaf, np.float32) for leaf in leaves]
+
+        def set_params(values):
+            set_tree(jax.tree_util.tree_unflatten(self._treedef, values))
+
+        super().__init__(get_params, set_params)
+
+
+class SyncEveryN:
+    """Callback: sync the manager every N calls (the keras callback's
+    every-N-batches contract, ref: keras_ext/callbacks.py:8-39)."""
+
+    def __init__(self, manager: MVModelParamManager, n: int = 1):
+        self.manager = manager
+        self.n = max(int(n), 1)
+        self._count = 0
+
+    def __call__(self) -> None:
+        self._count += 1
+        if self._count % self.n == 0:
+            self.manager.sync_all_param()

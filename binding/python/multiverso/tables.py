@@ -1,0 +1,97 @@
+"""Table handlers (ref: binding/python/multiverso/tables.py:38-165).
+
+Float32 numpy marshalling and the master-init convention preserved: when
+``init_value`` is given, every worker performs a synchronous add — the
+master adds the value, the rest add zeros — so initialization also lines
+up the BSP clocks in sync mode (ref: tables.py:52-58).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import multiverso_tpu as _mv
+
+from . import api
+
+
+def _convert(data) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(data, dtype=np.float32))
+
+
+class TableHandler:
+    def __init__(self, size, init_value=None):
+        raise NotImplementedError
+
+    def get(self):
+        raise NotImplementedError
+
+    def add(self, data, sync=False):
+        raise NotImplementedError
+
+
+class ArrayTableHandler(TableHandler):
+    """Sync a one-dimensional float array."""
+
+    def __init__(self, size: int, init_value=None):
+        self._size = int(size)
+        self._table = _mv.create_array_table(self._size, dtype=np.float32)
+        if init_value is not None:
+            init_value = _convert(init_value)
+            self.add(init_value if api.is_master_worker()
+                     else np.zeros(init_value.shape, np.float32), sync=True)
+
+    def get(self) -> np.ndarray:
+        out = np.zeros(self._size, dtype=np.float32)
+        self._table.get(out=out)
+        return out
+
+    def add(self, data, sync: bool = False) -> None:
+        data = _convert(data).reshape(-1)
+        assert data.size == self._size
+        if sync:
+            self._table.add(data)
+        else:
+            self._table.add_async(data.copy())
+
+
+class MatrixTableHandler(TableHandler):
+    """Sync a two-dimensional float matrix, whole or by rows."""
+
+    def __init__(self, num_row: int, num_col: int, init_value=None):
+        self._num_row, self._num_col = int(num_row), int(num_col)
+        self._size = self._num_row * self._num_col
+        self._table = _mv.create_matrix_table(self._num_row, self._num_col,
+                                              dtype=np.float32)
+        if init_value is not None:
+            init_value = _convert(init_value)
+            self.add(init_value if api.is_master_worker()
+                     else np.zeros(init_value.shape, np.float32), sync=True)
+
+    def get(self, row_ids=None) -> np.ndarray:
+        if row_ids is None:
+            out = np.zeros((self._num_row, self._num_col), np.float32)
+            self._table.get(out=out)
+            return out
+        row_ids = np.asarray(list(row_ids), dtype=np.int32)
+        out = np.zeros((row_ids.size, self._num_col), np.float32)
+        self._table.get_rows(row_ids, out=out)
+        return out
+
+    def add(self, data=None, row_ids=None, sync: bool = False) -> None:
+        assert data is not None
+        data = _convert(data)
+        if row_ids is None:
+            assert data.size == self._size
+            if sync:
+                self._table.add(data)
+            else:
+                self._table.add_async(data.copy())
+            return
+        row_ids = np.asarray(list(row_ids), dtype=np.int32)
+        assert data.size == row_ids.size * self._num_col
+        data = data.reshape(row_ids.size, self._num_col)
+        if sync:
+            self._table.add_rows(row_ids, data)
+        else:
+            self._table.add_rows_async(row_ids.copy(), data.copy())

@@ -1,0 +1,102 @@
+"""Python half of the C ABI shim (loaded by native/c_api).
+
+The C library (native/c_api/multiverso_c_api.cpp) forwards every c_api
+call (ref: include/multiverso/c_api.h:14-54) here; buffers arrive as
+zero-copy memoryviews over caller memory, wrapped as numpy arrays. Float32
+only, matching the reference's c_api instantiation (ref: src/c_api.cpp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+def init(argv) -> None:
+    # The reference's binding passes a throwaway argv[0] placeholder
+    # (ref: binding/python/multiverso/api.py init); drop it like
+    # ParseCMDFlags skips the program name.
+    mv.init(list(argv[1:]) if argv else [])
+
+
+def shutdown() -> None:
+    mv.shutdown()
+
+
+def barrier() -> None:
+    mv.barrier()
+
+
+def num_workers() -> int:
+    return mv.num_workers()
+
+
+def worker_id() -> int:
+    return mv.worker_id()
+
+
+def server_id() -> int:
+    return mv.server_id()
+
+
+def _float_array(view, size=None) -> np.ndarray:
+    arr = np.frombuffer(view, dtype=np.float32)
+    return arr if size is None else arr[:size]
+
+
+def _int_array(view) -> np.ndarray:
+    return np.frombuffer(view, dtype=np.int32)
+
+
+# -- array table --
+
+def new_array_table(size: int):
+    return mv.create_array_table(size, dtype=np.float32)
+
+
+def get_array_table(table, out_view) -> None:
+    out = _float_array(out_view)
+    table.get(out=out)
+
+
+def add_array_table(table, delta_view, sync: int) -> None:
+    delta = _float_array(delta_view)
+    if sync:
+        table.add(delta)
+    else:
+        table.add_async(delta.copy())  # caller may reuse its buffer
+
+
+# -- matrix table --
+
+def new_matrix_table(num_row: int, num_col: int):
+    return mv.create_matrix_table(num_row, num_col, dtype=np.float32)
+
+
+def get_matrix_all(table, out_view) -> None:
+    out = _float_array(out_view).reshape(table.num_row, table.num_col)
+    table.get(out=out)
+
+
+def add_matrix_all(table, delta_view, sync: int) -> None:
+    delta = _float_array(delta_view)
+    if sync:
+        table.add(delta)
+    else:
+        table.add_async(delta.copy())
+
+
+def get_matrix_rows(table, out_view, rows_view) -> None:
+    rows = _int_array(rows_view)
+    out = _float_array(out_view).reshape(rows.size, table.num_col)
+    table.get_rows(rows, out=out)
+
+
+def add_matrix_rows(table, delta_view, rows_view, sync: int) -> None:
+    rows = _int_array(rows_view)
+    delta = _float_array(delta_view).reshape(rows.size, table.num_col)
+    if sync:
+        table.add_rows(rows, delta)
+    else:
+        table.add_rows_async(rows.copy(), delta.copy())

@@ -37,7 +37,6 @@ import numpy as np
 
 from ... import create_kv_table, create_matrix_table
 from ...updater.engine import pad_ids
-from ...util import log
 from .data import CbowBatch, PairBatch
 from .dictionary import Dictionary
 from .huffman import build_huffman
@@ -141,12 +140,17 @@ class Word2Vec:
         return jax.jit(step,
                        donate_argnums=(0,) if self._DONATE else ())
 
-    def _neg_pair_loss(self, v, targets, emb_out, pair_mask, key):
-        """SGNS: positive target + K in-jit sampled negatives."""
+    def _neg_pair_loss(self, v, targets, emb_out, pair_mask, key,
+                       negatives=None):
+        """SGNS: positive target + K negatives — sampled in-jit locally,
+        or host-provided in PS mode (the PS pull needs to know the rows
+        before the step runs, like the reference's block preparation,
+        ref: communicator.cpp:117-155)."""
         k = self.config.negative
         batch = v.shape[0]
-        uniform = jax.random.uniform(key, (batch, k))
-        negatives = jnp.searchsorted(self._neg_cdf, uniform)
+        if negatives is None:
+            uniform = jax.random.uniform(key, (batch, k))
+            negatives = jnp.searchsorted(self._neg_cdf, uniform)
         cols = jnp.concatenate([targets[:, None], negatives], axis=1)
         u = emb_out[cols]  # [B, 1+K, D]
         # MAX_EXP clamp, exactly word2vec's sigmoid table: saturated pairs

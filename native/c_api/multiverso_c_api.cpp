@@ -1,0 +1,221 @@
+// libmultiverso.so — C ABI shim over the TPU-native Python runtime.
+//
+// Byte-compatible with the reference's c_api
+// (ref: include/multiverso/c_api.h:14-54, src/c_api.cpp:10-93): the same
+// exported symbols, float-only Array/Matrix tables, and the opaque
+// TableHandler lifecycle, so the reference's ctypes/LuaJIT-FFI/C# bindings
+// load this library unmodified. Instead of an MPI actor system behind the
+// ABI, each call forwards into the embedded (or host) CPython interpreter
+// running multiverso_tpu; tensors cross the boundary as zero-copy
+// memoryviews (multiverso_tpu/capi.py wraps them as numpy arrays).
+//
+// Works in two hosting modes:
+//  - loaded into an existing Python process (ctypes): attaches to the
+//    running interpreter via PyGILState;
+//  - loaded by a non-Python host (Lua/C#/C++): initializes an embedded
+//    interpreter on MV_Init.
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace {
+
+bool g_owns_interpreter = false;
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+void fatal_on_pyerr(const char* where) {
+  if (PyErr_Occurred()) {
+    std::fprintf(stderr, "[multiverso c_api] python error in %s:\n", where);
+    PyErr_Print();
+    std::abort();
+  }
+}
+
+PyObject* capi_module() {
+  static PyObject* module = nullptr;
+  if (module == nullptr) {
+    module = PyImport_ImportModule("multiverso_tpu.capi");
+    fatal_on_pyerr("import multiverso_tpu.capi");
+  }
+  return module;
+}
+
+// Call multiverso_tpu.capi.<name>(*args); returns new reference.
+PyObject* call(const char* name, PyObject* args) {
+  PyObject* fn = PyObject_GetAttrString(capi_module(), name);
+  fatal_on_pyerr(name);
+  PyObject* result = PyObject_CallObject(fn, args);
+  fatal_on_pyerr(name);
+  Py_XDECREF(fn);
+  Py_XDECREF(args);
+  return result;
+}
+
+PyObject* float_view(float* data, int size, int writable) {
+  return PyMemoryView_FromMemory(reinterpret_cast<char*>(data),
+                                 static_cast<Py_ssize_t>(size) * 4,
+                                 writable ? PyBUF_WRITE : PyBUF_READ);
+}
+
+PyObject* int_view(int* data, int size) {
+  return PyMemoryView_FromMemory(reinterpret_cast<char*>(data),
+                                 static_cast<Py_ssize_t>(size) * 4,
+                                 PyBUF_READ);
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* TableHandler;
+
+void MV_Init(int* argc, char* argv[]) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interpreter = true;
+    // Release the GIL so Gil{} works uniformly afterwards.
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  PyObject* args_list = PyList_New(0);
+  int n = (argc != nullptr) ? *argc : 0;
+  for (int i = 0; i < n; ++i) {
+    PyObject* s = PyUnicode_FromString(argv[i] ? argv[i] : "");
+    PyList_Append(args_list, s);
+    Py_DECREF(s);
+  }
+  Py_XDECREF(call("init", Py_BuildValue("(N)", args_list)));
+}
+
+void MV_ShutDown() {
+  {
+    Gil gil;
+    Py_XDECREF(call("shutdown", nullptr));
+  }
+  // The embedded interpreter (non-Python hosts) stays alive: JAX runtimes
+  // do not survive re-initialization, and the reference keeps MPI alive
+  // across MV_ShutDown(false) the same way.
+}
+
+void MV_Barrier() {
+  Gil gil;
+  Py_XDECREF(call("barrier", nullptr));
+}
+
+int MV_NumWorkers() {
+  Gil gil;
+  PyObject* result = call("num_workers", nullptr);
+  long value = PyLong_AsLong(result);
+  Py_XDECREF(result);
+  return static_cast<int>(value);
+}
+
+int MV_WorkerId() {
+  Gil gil;
+  PyObject* result = call("worker_id", nullptr);
+  long value = PyLong_AsLong(result);
+  Py_XDECREF(result);
+  return static_cast<int>(value);
+}
+
+int MV_ServerId() {
+  Gil gil;
+  PyObject* result = call("server_id", nullptr);
+  long value = PyLong_AsLong(result);
+  Py_XDECREF(result);
+  return static_cast<int>(value);
+}
+
+// -- Array table (float only, as in the reference) --
+
+void MV_NewArrayTable(int size, TableHandler* out) {
+  Gil gil;
+  *out = call("new_array_table", Py_BuildValue("(i)", size));
+}
+
+void MV_GetArrayTable(TableHandler handler, float* data, int size) {
+  Gil gil;
+  Py_XDECREF(call("get_array_table",
+                  Py_BuildValue("(ON)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 1))));
+}
+
+void MV_AddArrayTable(TableHandler handler, float* data, int size) {
+  Gil gil;
+  Py_XDECREF(call("add_array_table",
+                  Py_BuildValue("(ONi)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 0), 1)));
+}
+
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size) {
+  Gil gil;
+  Py_XDECREF(call("add_array_table",
+                  Py_BuildValue("(ONi)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 0), 0)));
+}
+
+// -- Matrix table --
+
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
+  Gil gil;
+  *out = call("new_matrix_table", Py_BuildValue("(ii)", num_row, num_col));
+}
+
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size) {
+  Gil gil;
+  Py_XDECREF(call("get_matrix_all",
+                  Py_BuildValue("(ON)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 1))));
+}
+
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size) {
+  Gil gil;
+  Py_XDECREF(call("add_matrix_all",
+                  Py_BuildValue("(ONi)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 0), 1)));
+}
+
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size) {
+  Gil gil;
+  Py_XDECREF(call("add_matrix_all",
+                  Py_BuildValue("(ONi)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 0), 0)));
+}
+
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  Gil gil;
+  Py_XDECREF(call("get_matrix_rows",
+                  Py_BuildValue("(ONN)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 1),
+                                int_view(row_ids, row_ids_n))));
+}
+
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  Gil gil;
+  Py_XDECREF(call("add_matrix_rows",
+                  Py_BuildValue("(ONNi)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 0),
+                                int_view(row_ids, row_ids_n), 1)));
+}
+
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data,
+                                  int size, int row_ids[], int row_ids_n) {
+  Gil gil;
+  Py_XDECREF(call("add_matrix_rows",
+                  Py_BuildValue("(ONNi)", static_cast<PyObject*>(handler),
+                                float_view(data, size, 0),
+                                int_view(row_ids, row_ids_n), 0)));
+}
+
+}  // extern "C"

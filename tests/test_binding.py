@@ -1,0 +1,155 @@
+"""Binding tests: Python package, param managers, and the C ABI shim.
+
+Ports the reference's binding test semantics
+(ref: binding/python/multiverso/tests/test_multiverso.py:18-60 — array and
+matrix handler roundtrips with init_value) and exercises the C ABI
+(ref: include/multiverso/c_api.h) through ctypes exactly the way the
+reference's utils.Loader does.
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+BINDING_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "binding", "python")
+if BINDING_PATH not in sys.path:
+    sys.path.insert(0, BINDING_PATH)
+
+import multiverso as mv_binding  # noqa: E402
+from multiverso.ext import (JaxParamManager, MVModelParamManager,  # noqa: E402
+                            SyncEveryN, TorchParamManager)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_PATH = os.path.join(REPO, "native", "build", "libmultiverso.so")
+
+
+@pytest.fixture
+def env():
+    mv_binding.init()
+    yield
+    mv_binding.shutdown()
+
+
+class TestPythonBinding:
+    def test_array_handler_roundtrip(self, env):
+        # ref: test_multiverso.py array test — init_value lands once
+        # (master), adds accumulate.
+        init = np.arange(10, dtype=np.float32)
+        handler = mv_binding.ArrayTableHandler(10, init_value=init)
+        mv_binding.barrier()
+        np.testing.assert_array_equal(handler.get(), init)
+        handler.add(np.ones(10), sync=True)
+        handler.add(np.ones(10), sync=True)
+        np.testing.assert_array_equal(handler.get(), init + 2)
+
+    def test_matrix_handler_rows(self, env):
+        handler = mv_binding.MatrixTableHandler(6, 4)
+        data = np.arange(24, dtype=np.float32).reshape(6, 4)
+        handler.add(data, sync=True)
+        np.testing.assert_array_equal(handler.get(), data)
+        rows = handler.get(row_ids=[1, 3])
+        np.testing.assert_array_equal(rows, data[[1, 3]])
+        handler.add(np.ones((2, 4)), row_ids=[1, 3], sync=True)
+        np.testing.assert_array_equal(handler.get(row_ids=[1]),
+                                      data[[1]] + 1)
+
+    def test_api_identity(self, env):
+        assert mv_binding.workers_num() == 1
+        assert mv_binding.worker_id() == 0
+        assert mv_binding.is_master_worker()
+
+
+class TestParamManagers:
+    def test_generic_manager_syncs_deltas(self, env):
+        state = {"params": [np.zeros(4, np.float32),
+                            np.ones((2, 2), np.float32)]}
+        manager = MVModelParamManager(
+            lambda: state["params"],
+            lambda vals: state.update(params=vals))
+        state["params"][0] += 5  # local training step
+        manager.sync_all_param()
+        np.testing.assert_array_equal(state["params"][0],
+                                      np.full(4, 5, np.float32))
+        np.testing.assert_array_equal(state["params"][1],
+                                      np.ones((2, 2), np.float32))
+
+    def test_sync_every_n(self, env):
+        state = {"params": [np.zeros(2, np.float32)]}
+        manager = MVModelParamManager(
+            lambda: state["params"],
+            lambda vals: state.update(params=vals))
+        callback = SyncEveryN(manager, n=2)
+        state["params"][0] += 1
+        callback()  # 1st call: no sync yet
+        server = manager.table.get()
+        assert server.sum() == 0
+        callback()  # 2nd call: syncs
+        assert manager.table.get().sum() == pytest.approx(2.0)
+
+    def test_torch_manager(self, env):
+        torch = pytest.importorskip("torch")
+        module = torch.nn.Linear(3, 2)
+        manager = TorchParamManager(module)
+        with torch.no_grad():
+            for p in module.parameters():
+                p.add_(1.0)
+        manager.sync_all_param()
+        merged = [p.detach().numpy() for p in module.parameters()]
+        assert all(np.isfinite(m).all() for m in merged)
+
+    def test_jax_manager(self, env):
+        import jax.numpy as jnp
+        state = {"tree": {"w": jnp.zeros(3), "b": jnp.ones(2)}}
+        manager = JaxParamManager(lambda: state["tree"],
+                                  lambda t: state.update(tree=t))
+        state["tree"] = {"w": state["tree"]["w"] + 2.0,
+                         "b": state["tree"]["b"]}
+        manager.sync_all_param()
+        np.testing.assert_allclose(np.asarray(state["tree"]["w"]),
+                                   np.full(3, 2.0))
+
+
+@pytest.mark.skipif(not os.path.exists(LIB_PATH),
+                    reason="libmultiverso.so not built (make -C native)")
+class TestCApiShim:
+    def test_full_roundtrip_in_subprocess(self):
+        # Load the shared library the way the reference binding does and
+        # drive the whole ABI. Subprocess: the shim init conflicts with an
+        # already-initialized zoo in this process.
+        code = f"""
+import ctypes, numpy as np
+lib = ctypes.CDLL({LIB_PATH!r})
+args = [b"prog"]
+args_t = ctypes.c_char_p * 1
+lib.MV_Init(ctypes.pointer(ctypes.c_int(1)), args_t(*args))
+assert lib.MV_NumWorkers() == 1
+h = ctypes.c_void_p()
+lib.MV_NewArrayTable(8, ctypes.byref(h))
+data = np.arange(8, dtype=np.float32)
+fp = ctypes.POINTER(ctypes.c_float)
+lib.MV_AddArrayTable(h, data.ctypes.data_as(fp), 8)
+out = np.zeros(8, dtype=np.float32)
+lib.MV_GetArrayTable(h, out.ctypes.data_as(fp), 8)
+assert (out == data).all(), out
+mh = ctypes.c_void_p()
+lib.MV_NewMatrixTable(4, 2, ctypes.byref(mh))
+rows = np.array([0, 3], dtype=np.int32)
+ip = ctypes.POINTER(ctypes.c_int)
+vals = np.ones(4, dtype=np.float32)
+lib.MV_AddMatrixTableByRows(mh, vals.ctypes.data_as(fp), 4,
+                            rows.ctypes.data_as(ip), 2)
+allv = np.zeros(8, dtype=np.float32)
+lib.MV_GetMatrixTableAll(mh, allv.ctypes.data_as(fp), 8)
+assert allv.sum() == 4
+lib.MV_Barrier(); lib.MV_ShutDown()
+print("C_ABI_OK")
+"""
+        result = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=240, env=dict(os.environ, PYTHONPATH=REPO))
+        assert "C_ABI_OK" in result.stdout, result.stderr[-800:]
