@@ -65,9 +65,18 @@ class NetInterface:
         default drives the AllreduceEngine over this endpoint's raw
         send/recv (ma mode only — the PS actors must not own the endpoint);
         transports with a native collective override this (LocalNet uses
-        shared memory, an MPI-like transport would use its own)."""
+        shared memory, an MPI-like transport would use its own).
+
+        One engine is cached per endpoint: its stash of early-arriving
+        messages must survive across calls, since in back-to-back
+        allreduces a fast peer's next-call message (tags restart at fixed
+        bases) can be drained during the previous call and would otherwise
+        be lost, deadlocking the next collective."""
         from .allreduce_engine import AllreduceEngine
-        return AllreduceEngine(self).allreduce(array)
+        engine = getattr(self, "_allreduce_engine", None)
+        if engine is None:
+            engine = self._allreduce_engine = AllreduceEngine(self)
+        return engine.allreduce(array)
 
     @property
     def name(self) -> str:

@@ -107,7 +107,12 @@ class MatrixWorker(WorkerTable):
 
     def get_async(self, out: Optional[np.ndarray] = None) -> int:
         if out is None:
-            out = np.empty((self.num_row, self.num_col), self.dtype)
+            # Sparse whole-table gets return only dirty rows, so a fresh
+            # destination must be zeroed or the clean rows would surface
+            # uninitialized memory; callers wanting incremental semantics
+            # should pass a persistent out buffer.
+            alloc = np.zeros if self.is_sparse else np.empty
+            out = alloc((self.num_row, self.num_col), self.dtype)
         CHECK(out.shape == (self.num_row, self.num_col), "bad output shape")
         self._dest, self._dest_rows, self._device_shards = out, None, None
         return self._request_get(Blob(_ALL_KEY.view(np.uint8)))
@@ -312,12 +317,19 @@ class MatrixServer(ServerTable):
             self._mark_dirty(local_rows, option)
 
     def _mark_dirty(self, rows, option: Optional[AddOption]) -> None:
-        """An Add invalidates the rows for every consumer except the adder
-        (ref: sparse_matrix_table.cpp:200-223)."""
-        self._up_to_date[:, rows] = False
-        if option is not None and 0 <= option.worker_id < \
-                self._up_to_date.shape[0]:
-            self._up_to_date[option.worker_id, rows] = True
+        """An Add invalidates the rows for every consumer except the adder,
+        whose existing flags are left untouched — only Gets may mark a row
+        up-to-date (ref: sparse_matrix_table.cpp:200-223). Setting the
+        adder's flag True here would erase a pending dirty mark another
+        worker's Add left on the same row, so the adder would read stale
+        values on its next dirty-only Get."""
+        adder = option.worker_id if option is not None else -1
+        if 0 <= adder < self._up_to_date.shape[0]:
+            saved = self._up_to_date[adder, rows].copy()
+            self._up_to_date[:, rows] = False
+            self._up_to_date[adder, rows] = saved
+        else:
+            self._up_to_date[:, rows] = False
 
     # -- Get (ref: matrix_table.cpp:420-454, sparse_matrix_table.cpp:226-309)
     def process_get(self, blobs: List[Blob]) -> List[Blob]:

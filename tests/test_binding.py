@@ -28,6 +28,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LIB_PATH = os.path.join(REPO, "native", "build", "libmultiverso.so")
 
 
+def _build_lib() -> bool:
+    """Build the c_api shim from source (the .so is a build artifact, not
+    checked in); returns whether it is available."""
+    if not os.path.exists(LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                           capture_output=True, timeout=300, check=False)
+        except subprocess.TimeoutExpired:
+            return False
+    return os.path.exists(LIB_PATH)
+
+
 @pytest.fixture
 def env():
     mv_binding.init()
@@ -114,8 +126,9 @@ class TestParamManagers:
                                    np.full(3, 2.0))
 
 
-@pytest.mark.skipif(not os.path.exists(LIB_PATH),
-                    reason="libmultiverso.so not built (make -C native)")
+@pytest.mark.skipif(not _build_lib(),
+                    reason="libmultiverso.so failed to build "
+                           "(make -C native)")
 class TestCApiShim:
     def test_full_roundtrip_in_subprocess(self):
         # Load the shared library the way the reference binding does and

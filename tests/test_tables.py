@@ -148,6 +148,32 @@ class TestSparseMatrix:
         # Nothing dirty for worker 0 -> buffer untouched.
         np.testing.assert_array_equal(stale, np.full((8, 2), -7.0))
 
+    def test_adder_does_not_clean_others_dirty_mark(self, env):
+        # Regression (round-1 advice): worker B dirties a row, then worker
+        # A adds to that same row. A's pending dirty mark must survive A's
+        # own add — only Gets clean flags (ref: sparse_matrix_table.cpp
+        # UpdateAddState skips just the adder) — so A's next dirty-only get
+        # still returns the row with B's update folded in.
+        table = mv.create_matrix_table(4, 2, is_sparse=True)
+        table.get()  # worker 0: everything clean
+        table.add_rows(np.array([2], np.int32),
+                       np.ones((1, 2), np.float32),
+                       option=AddOption(worker_id=1))  # B's add
+        table.add_rows(np.array([2], np.int32),
+                       np.ones((1, 2), np.float32),
+                       option=AddOption(worker_id=0))  # A's add
+        buf = np.full((4, 2), -1.0, np.float32)
+        table.get(out=buf)  # A's dirty-only get
+        np.testing.assert_array_equal(buf[2], [2.0, 2.0])
+
+    def test_sparse_get_zeroed_when_out_omitted(self, env):
+        # Regression (round-1 advice): a sparse whole-table get with no out
+        # buffer must not surface uninitialized memory in clean rows.
+        table = mv.create_matrix_table(4, 2, is_sparse=True)
+        table.get()  # clean all for worker 0
+        out = table.get()  # nothing dirty -> all rows must read as zeros
+        np.testing.assert_array_equal(out, np.zeros((4, 2), np.float32))
+
     def test_row_get_marks_clean(self, env):
         table = mv.create_matrix_table(6, 2, is_sparse=True)
         table.get()  # clean all
