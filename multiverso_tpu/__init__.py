@@ -81,3 +81,17 @@ def aggregate(data):
     """MV_Aggregate: sum-allreduce a host array across ranks
     (ref: src/multiverso.cpp:53-56, net::Allreduce src/net.cpp:27-35)."""
     return current_zoo().net.allreduce(data)
+
+
+def net_bind(rank: int, endpoint: str) -> None:
+    """MV_NetBind (ref: include/multiverso/multiverso.h:55-59): declare
+    this process's rank and ``host:port`` endpoint before ``init``."""
+    from .runtime.tcp import net_bind as _net_bind
+    _net_bind(rank, endpoint)
+
+
+def net_connect(ranks, endpoints) -> None:
+    """MV_NetConnect (ref: include/multiverso/multiverso.h:60-64): supply
+    peer endpoints and build the TCP mesh consumed by the next ``init``."""
+    from .runtime.tcp import net_connect as _net_connect
+    _net_connect(list(ranks), list(endpoints))

@@ -30,19 +30,26 @@ class Communicator(Actor):
 
     def start(self) -> None:
         super().start()
+        self._net.acquire_recv_owner()
         self._recv_thread = threading.Thread(
             target=self._recv_main,
             name=f"mv-comm-recv-r{self._zoo.rank}", daemon=True)
         self._recv_thread.start()
 
     def stop(self, finalize_net: bool = True) -> None:
+        # Drain-exit the actor thread BEFORE closing the transport: replies
+        # the controller queued for remote ranks may not have hit the wire
+        # yet, and finalizing first silently drops them — the peer then
+        # hangs forever in its final barrier. (LocalNet's direct in-process
+        # delivery masks this; a real wire transport does not.)
+        super().stop()
         if finalize_net:
             self._net.finalize()
         else:
             self._net.interrupt_recv()
         if self._recv_thread is not None:
             self._recv_thread.join(timeout=30)
-        super().stop()
+        self._net.release_recv_owner()
 
     # Outbound path: actor mailbox -> wire (or loop back locally); every
     # message type goes through the same route-or-send dispatch.

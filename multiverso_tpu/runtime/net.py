@@ -59,6 +59,17 @@ class NetInterface:
         endpoint down (used for non-finalizing shutdown)."""
         self.finalize()
 
+    # -- recv ownership: exactly one consumer may drain the endpoint --
+    def acquire_recv_owner(self) -> None:
+        """Mark this endpoint as drained by an actor (the communicator's
+        recv thread). While owned, the default transport-level allreduce
+        must refuse to run: it would race the recv thread for messages and
+        corrupt both streams."""
+        self._recv_owned = True
+
+    def release_recv_owner(self) -> None:
+        self._recv_owned = False
+
     def allreduce(self, array: "np.ndarray") -> "np.ndarray":
         """Sum-allreduce a host array across ranks (the transport-level
         collective behind MV_Aggregate, ref: mpi_net.h:147-151). The
@@ -72,6 +83,11 @@ class NetInterface:
         allreduces a fast peer's next-call message (tags restart at fixed
         bases) can be drained during the previous call and would otherwise
         be lost, deadlocking the next collective."""
+        if getattr(self, "_recv_owned", False):
+            raise RuntimeError(
+                "transport-level allreduce (mv.aggregate) requires ma mode "
+                "on this transport: the PS actors own the endpoint's recv "
+                "stream (start with -ma=true, ref: src/net.cpp:27-35)")
         from .allreduce_engine import AllreduceEngine
         engine = getattr(self, "_allreduce_engine", None)
         if engine is None:

@@ -33,6 +33,7 @@ from .communicator import Communicator
 from .controller import Controller
 from .net import LocalFabric, NetInterface
 from .server import Server
+from .tcp import TcpNet, take_pending_net
 from .worker import Worker
 
 define_string("ps_role", "default", "none / worker / server / default(all)")
@@ -86,7 +87,7 @@ class Zoo:
         registry is process-global; virtual ranks with heterogeneous roles
         need a per-zoo override)."""
         remaining = parse_cmd_flags(argv)
-        self._net = net if net is not None else LocalFabric(1).endpoint(0)
+        self._net = net if net is not None else self._resolve_net()
         self._role_override = role
         if not get_flag("ma"):
             self._start_ps()
@@ -106,6 +107,17 @@ class Zoo:
         self._server_tables.clear()
         self._started = False
         log.debug("Rank %d: multiverso shut down", self.rank)
+
+    def _resolve_net(self) -> NetInterface:
+        """Transport selection after flag parsing: an endpoint prepared by
+        net_bind/net_connect wins, then a -machine_file TCP mesh
+        (ref: zmq_net.h:25-61), else the single-rank in-process default."""
+        pending = take_pending_net()
+        if pending is not None:
+            return pending
+        if get_flag("machine_file"):
+            return TcpNet.from_flags()
+        return LocalFabric(1).endpoint(0)
 
     def _start_ps(self) -> None:
         role = int(role_from_string(self._role_override

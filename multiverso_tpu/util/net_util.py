@@ -1,0 +1,64 @@
+"""Local address enumeration for machine-file rank discovery.
+
+TPU-native equivalent of the reference's ``net_util``
+(ref: src/util/net_util.cpp, include/multiverso/util/net_util.h:10): the
+ZMQ transport finds its own rank by matching the machine file's addresses
+against the local interfaces (ref: zmq_net.h:25-61). Implemented with the
+standard library only.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Set
+
+
+def local_addresses() -> Set[str]:
+    """Names/IPs that resolve to this host (always includes loopback)."""
+    addrs = {"127.0.0.1", "localhost", "0.0.0.0", "::1"}
+    hostname = socket.gethostname()
+    addrs.add(hostname)
+    try:
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    try:
+        # UDP connect trick: the OS picks the outbound interface address
+        # without sending a packet.
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            addrs.add(s.getsockname()[0])
+    except OSError:
+        pass
+    return addrs
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (for tests and single-host launches)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+_next_listen_port = 21000 + (__import__("os").getpid() % 400) * 20
+
+
+def free_listen_port(host: str = "127.0.0.1") -> int:
+    """A free port *below* the OS ephemeral range (Linux default
+    32768-60999). Ports from ``free_port`` can be stolen between probe and
+    listener bind by a peer's outbound connection, whose OS-assigned
+    source port comes from that same ephemeral range; handing processes
+    listen ports outside it removes the race."""
+    global _next_listen_port
+    while True:
+        port = _next_listen_port
+        _next_listen_port += 1
+        if _next_listen_port >= 32700:
+            _next_listen_port = 21000
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind((host, port))
+            except OSError:
+                continue
+            return port
