@@ -80,14 +80,9 @@ def run(argv=None) -> Word2Vec:
             cbow=config.cbow, seed=config.seed + epoch)
         iterator = BlockLoader(batches) if get_flag("is_pipeline") \
             else batches
-        # Async hot loop: device losses accumulate without host syncs; one
-        # materialization per epoch.
-        pair_count = 0
-        losses = []
-        for batch in iterator:
-            losses.append(model.train_batch_async(batch))
-            pair_count += batch.count
-        loss_sum = sum(float(loss) for loss in losses)
+        # Hot loop lives in the model: local mode accumulates device
+        # losses without host syncs; PS mode pipelines pull/train/push.
+        loss_sum, pair_count = model.train_batches(iterator)
         elapsed = time.perf_counter() - start
         log.info("epoch %d: avg pair loss %.4f, %.0f words/s", epoch,
                  loss_sum / max(pair_count, 1),

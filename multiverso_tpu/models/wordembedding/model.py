@@ -78,25 +78,43 @@ class Word2Vec:
     def __init__(self, config: Word2VecConfig, dictionary: Dictionary):
         self.config = config
         self.dictionary = dictionary
-        vocab, dim = dictionary.size, config.embedding_size
-        rng = np.random.default_rng(config.seed)
-        # ref init: uniform (-0.5/dim, 0.5/dim) input, zeros output.
-        self._emb_in = jnp.asarray(
-            (rng.random((vocab, dim)) - 0.5) / dim, jnp.float32)
-        if config.hs:
-            tree = build_huffman(dictionary.counts)
-            self._codes = jnp.asarray(tree.codes)
-            self._points = jnp.asarray(tree.points)
-            out_rows = max(tree.num_inner_nodes, 1)
-        else:
-            neg = dictionary.negative_table()
-            self._neg_cdf = jnp.asarray(np.cumsum(neg))
-            out_rows = vocab
-        self._emb_out = jnp.zeros((out_rows, dim), jnp.float32)
+        self._out_rows = self._init_output_structures()
         self._key = jax.random.PRNGKey(config.seed)
-        self._step = self._build_step()
         self.trained_words = 0
         self.total_words = dictionary.total_count * config.epochs
+        self._init_embeddings()
+
+    def _init_output_structures(self) -> int:
+        """Huffman tables (hs) or the unigram^0.75 CDF (sgns); returns the
+        output-embedding row count. Host copies back the PS row-set
+        preparation (which must know the touched output rows before the
+        device step runs)."""
+        config, dictionary = self.config, self.dictionary
+        if config.hs:
+            tree = build_huffman(dictionary.counts)
+            self._codes_host = np.asarray(tree.codes)
+            self._points_host = np.asarray(tree.points)
+            self._codes = jnp.asarray(tree.codes)
+            self._points = jnp.asarray(tree.points)
+            return max(tree.num_inner_nodes, 1)
+        neg = dictionary.negative_table()
+        # float64 accumulation: a float32 cumsum's last entry lands
+        # measurably below 1.0 and uniform draws above it would index one
+        # past the last word.
+        self._neg_cdf_host = np.cumsum(neg, dtype=np.float64)
+        self._neg_cdf = jnp.asarray(self._neg_cdf_host)
+        return dictionary.size
+
+    def _init_embeddings(self) -> None:
+        """Local mode: full device-resident matrices. ref init: uniform
+        (-0.5/dim, 0.5/dim) input, zeros output. The PS subclass overrides
+        this with table creation (no full local copies)."""
+        vocab, dim = self.dictionary.size, self.config.embedding_size
+        rng = np.random.default_rng(self.config.seed)
+        self._emb_in = jnp.asarray(
+            (rng.random((vocab, dim)) - 0.5) / dim, jnp.float32)
+        self._emb_out = jnp.zeros((self._out_rows, dim), jnp.float32)
+        self._step = self._build_step()
 
     # -- learning rate schedule --
     def learning_rate(self) -> float:
@@ -207,6 +225,18 @@ class Word2Vec:
         loss = self.train_batch_async(batch)
         return float(loss) / max(batch.count, 1)  # display per-pair loss
 
+    def train_batches(self, iterator) -> Tuple[float, int]:
+        """Drive a whole batch stream; returns (loss_sum, pair_count).
+        Device losses accumulate without host syncs (one materialization
+        at the end). The PS subclass overrides this with a pipelined
+        pull/train/push loop."""
+        losses = []
+        pairs = 0
+        for batch in iterator:
+            losses.append(self.train_batch_async(batch))
+            pairs += batch.count
+        return float(sum(float(x) for x in losses)), pairs
+
     @property
     def embeddings(self) -> np.ndarray:
         return np.asarray(self._emb_in)
@@ -234,66 +264,293 @@ def _sigmoid_xent(logits, labels):
         + jnp.log1p(jnp.exp(-jnp.abs(logits)))
 
 
+def _pad_rows(rows: np.ndarray, minimum: int = 8) -> np.ndarray:
+    """Pad a sorted unique row-id set to the next power of two (bounded
+    set of jit trace shapes) by repeating the last id. Padded slots are
+    never referenced by the compact index maps, so their pulled contents
+    and deltas are irrelevant (deltas are sliced off before the push)."""
+    n = max(int(rows.size), 1)
+    target = max(minimum, 1 << (n - 1).bit_length())
+    if rows.size == 0:
+        return np.zeros(target, np.int32)
+    if rows.size == target:
+        return rows
+    return np.concatenate(
+        [rows, np.full(target - rows.size, rows[-1], np.int32)])
+
+
+class _Prep:
+    """One batch's prepared pull: row sets, compact index maps, and the
+    in-flight async Get requests."""
+
+    __slots__ = ("batch", "rows_in", "rows_out", "in_args", "out_args",
+                 "buf_in", "buf_out", "mid_in", "mid_out")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _Launched:
+    __slots__ = ("prep", "new_in", "new_out", "old_in", "old_out", "loss")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
 class PSWord2Vec(Word2Vec):
-    """Distributed trainer: embeddings live in row-sharded matrix tables;
-    each batch pulls the rows it touches, trains on device, and pushes
-    ``(new - old) / num_workers`` (ref: communicator.cpp:117-249). The
-    global word count rides a KV table for the lr schedule
-    (ref: communicator.cpp:251-259)."""
+    """Distributed trainer over row-sharded matrix tables.
+
+    Redesigned around the reference's block protocol
+    (ref: Applications/WordEmbedding/src/communicator.cpp:117-249,
+    distributed_wordembedding.cpp:203-224):
+
+    - each batch pulls ONLY the embedding rows it touches (input rows =
+      its centers/window words; output rows = its targets plus host-
+      sampled negatives or Huffman path nodes), never the full V x D
+      tables;
+    - the jitted step trains on the compact [R, D] row matrices (batch
+      indices are remapped host-side to compact slots), so step FLOPs and
+      HBM traffic scale with the batch, not the vocabulary;
+    - it pushes ``(new - old) / num_workers`` for exactly those rows;
+    - ``train_batches`` pipelines: while the device runs step i, the next
+      batch's row pull is already in flight through the server actors
+      (the reference's ``-is_pipeline`` prefetch overlap), and the word-
+      count KV traffic is async and amortized over ``_WC_SYNC`` batches
+      (ref: communicator.cpp:251-259 runs it on a side thread).
+    """
 
     _DONATE = False
+    _WC_SYNC = 16  # batches between global word-count syncs
 
     def __init__(self, config: Word2VecConfig, dictionary: Dictionary,
-                 num_workers: int = 1):
+                 num_workers: Optional[int] = None):
+        self._num_workers_override = num_workers
         super().__init__(config, dictionary)
-        vocab, dim = dictionary.size, config.embedding_size
-        out_rows = int(self._emb_out.shape[0])
-        self._in_table = create_matrix_table(vocab, dim,
-                                             updater_type="default")
-        self._out_table = create_matrix_table(out_rows, dim,
+        zoo = self._in_table.zoo
+        self._rng = np.random.default_rng(
+            config.seed + 97 * max(zoo.worker_id, 0))
+        self._compact_step = self._build_compact_step()
+        self._wc_pending = 0.0
+        self._batches_done = 0
+        self._pending_pushes: list = []
+
+    def _init_embeddings(self) -> None:
+        """No full local matrices: the input table is random-initialized
+        SERVER-side (the reference's random-init server ctor,
+        ref: matrix_table.cpp:372-384), so no V x D array ever
+        materializes on a worker — at reference scale (21M x D) it could
+        not."""
+        config = self.config
+        vocab, dim = self.dictionary.size, config.embedding_size
+        self._dim = dim
+        bound = 0.5 / dim
+        self._in_table = create_matrix_table(
+            vocab, dim, updater_type="default",
+            random_init=(-bound, bound), seed=config.seed)
+        self._out_table = create_matrix_table(self._out_rows, dim,
                                               updater_type="default")
         self._wc_table = create_kv_table()
-        self._num_workers = max(num_workers, 1)
-        # Seed the server with this worker's init (workers after the first
-        # add zeros-delta equivalents; with random per-rank init the model
-        # averages, mirroring the reference's master-init convention).
-        if self._in_table.zoo.worker_id == 0:
-            self._in_table.add(np.asarray(self._emb_in))
-        self._in_table.zoo.barrier()
-        self._pull_full()
+        zoo = self._in_table.zoo
+        self._num_workers = max(
+            zoo.num_workers if self._num_workers_override is None
+            else self._num_workers_override, 1)
 
-    def _pull_full(self) -> None:
-        self._emb_in = self._in_table.get_device().reshape(
-            self._emb_in.shape)
-        self._emb_out = self._out_table.get_device().reshape(
-            self._emb_out.shape)
+    # -- compact jitted step over pulled rows --
+    def _build_compact_step(self):
+        config = self.config
+
+        def input_vec(ein, in_args):
+            if config.cbow:
+                win_l, win_mask = in_args
+                vecs = ein[win_l] * win_mask[..., None]
+                denom = jnp.maximum(win_mask.sum(axis=1, keepdims=True),
+                                    1.0)
+                return vecs.sum(axis=1) / denom
+            (centers_l,) = in_args
+            return ein[centers_l]
+
+        if config.hs:
+            def loss_fn(ein, eout, in_args, out_args, pair_mask):
+                v = input_vec(ein, in_args)
+                points_l, codes = out_args
+                mask = (codes >= 0).astype(jnp.float32) * pair_mask[:, None]
+                u = eout[points_l]  # [B, L, D]
+                logits = jnp.clip(jnp.einsum("bd,bld->bl", v, u),
+                                  -_MAX_EXP, _MAX_EXP)
+                labels = 1.0 - codes.astype(jnp.float32)
+                return jnp.sum(_sigmoid_xent(logits, labels * mask) * mask)
+        else:
+            k = config.negative
+
+            def loss_fn(ein, eout, in_args, out_args, pair_mask):
+                v = input_vec(ein, in_args)
+                targets_l, negs_l = out_args
+                cols = jnp.concatenate([targets_l[:, None], negs_l], axis=1)
+                u = eout[cols]  # [B, 1+K, D]
+                logits = jnp.clip(jnp.einsum("bd,bkd->bk", v, u),
+                                  -_MAX_EXP, _MAX_EXP)
+                batch = v.shape[0]
+                labels = jnp.concatenate(
+                    [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
+                return jnp.sum(_sigmoid_xent(logits, labels)
+                               * pair_mask[:, None])
+
+        def step(ein, eout, lr, in_args, out_args, pair_mask):
+            loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                ein, eout, in_args, out_args, pair_mask)
+            return ein - lr * grads[0], eout - lr * grads[1], loss
+
+        return jax.jit(step)
+
+    # -- phase 1: row-set preparation + async pull --
+    def _prepare(self, batch) -> _Prep:
+        config = self.config
+        if isinstance(batch, CbowBatch):
+            win, targets = batch.window, batch.centers
+            real = win[win >= 0]
+            rows_in = np.unique(real).astype(np.int32) if real.size \
+                else np.zeros(1, np.int32)
+            win_l = np.clip(np.searchsorted(rows_in, np.maximum(win, 0)),
+                            0, rows_in.size - 1).astype(np.int32)
+            in_args = (win_l, (win >= 0).astype(np.float32))
+        else:
+            centers, targets = batch.centers, batch.contexts
+            rows_in = np.unique(centers).astype(np.int32)
+            in_args = (np.searchsorted(rows_in, centers).astype(np.int32),)
+
+        if config.hs:
+            points = self._points_host[targets]  # [B, L], -1 padded
+            real = points[points >= 0]
+            rows_out = np.unique(real).astype(np.int32) if real.size \
+                else np.zeros(1, np.int32)
+            points_l = np.clip(
+                np.searchsorted(rows_out, np.maximum(points, 0)),
+                0, rows_out.size - 1).astype(np.int32)
+            out_args = (points_l, self._codes_host[targets])
+        else:
+            k = config.negative
+            # Clip: a draw above cdf[-1] (float rounding) must not index
+            # one past the last word.
+            neg = np.minimum(
+                np.searchsorted(self._neg_cdf_host,
+                                self._rng.random((targets.size, k))),
+                self.dictionary.size - 1).astype(np.int32)
+            rows_out = np.unique(
+                np.concatenate([targets, neg.reshape(-1)])).astype(np.int32)
+            out_args = (np.searchsorted(rows_out, targets).astype(np.int32),
+                        np.searchsorted(rows_out, neg).astype(np.int32))
+
+        rows_in_p = _pad_rows(rows_in)
+        rows_out_p = _pad_rows(rows_out)
+        buf_in = np.empty((rows_in_p.size, self._dim), np.float32)
+        buf_out = np.empty((rows_out_p.size, self._dim), np.float32)
+        return _Prep(
+            batch=batch, rows_in=rows_in, rows_out=rows_out,
+            in_args=in_args, out_args=out_args,
+            buf_in=buf_in, buf_out=buf_out,
+            mid_in=self._in_table.get_rows_async(rows_in_p, out=buf_in),
+            mid_out=self._out_table.get_rows_async(rows_out_p, out=buf_out))
+
+    # -- phase 2: wait the pull, dispatch the device step (async) --
+    def _launch(self, prep: _Prep) -> _Launched:
+        self._in_table.wait(prep.mid_in)
+        self._out_table.wait(prep.mid_out)
+        old_in = jnp.asarray(prep.buf_in)
+        old_out = jnp.asarray(prep.buf_out)
+        size = prep.batch.centers.shape[0]
+        pair_mask = _full_mask(size) if prep.batch.count == size \
+            else jnp.asarray((np.arange(size) < prep.batch.count)
+                             .astype(np.float32))
+        new_in, new_out, loss = self._compact_step(
+            old_in, old_out, jnp.float32(self.learning_rate()),
+            tuple(jnp.asarray(a) for a in prep.in_args),
+            tuple(jnp.asarray(a) for a in prep.out_args), pair_mask)
+        return _Launched(prep=prep, new_in=new_in, new_out=new_out,
+                         old_in=old_in, old_out=old_out, loss=loss)
+
+    # -- phase 3: materialize deltas, push, account words --
+    def _finish(self, launched: _Launched) -> float:
+        prep = launched.prep
+        scale = 1.0 / self._num_workers
+        delta_in = np.asarray((launched.new_in - launched.old_in) * scale)
+        delta_out = np.asarray((launched.new_out - launched.old_out)
+                               * scale)
+        self._pending_pushes.append((self._in_table,
+                                     self._in_table.add_rows_async(
+                                         prep.rows_in,
+                                         delta_in[:prep.rows_in.size])))
+        self._pending_pushes.append((self._out_table,
+                                     self._out_table.add_rows_async(
+                                         prep.rows_out,
+                                         delta_out[:prep.rows_out.size])))
+        self._account_words(prep.batch.words)
+        return float(launched.loss) / max(prep.batch.count, 1)
+
+    def _drain_pushes(self) -> None:
+        """Wait every outstanding Add ack: a barrier alone orders only
+        controller traffic, not worker->server adds still in TCP flight —
+        peers reading after the barrier would nondeterministically miss
+        them."""
+        for table, msg_id in self._pending_pushes:
+            table.wait(msg_id)
+        self._pending_pushes.clear()
+
+    def _flush_word_count(self) -> None:
+        if self._wc_pending:
+            self._wc_table.add_async([0], [self._wc_pending])
+            self._wc_pending = 0.0
+
+    def _account_words(self, words: float) -> None:
+        """Global word count for the lr schedule via the KV table, synced
+        every _WC_SYNC batches (the reference keeps it off the hot path on
+        a side thread, ref: distributed_wordembedding.cpp:92-134)."""
+        self.trained_words += words
+        self._wc_pending += words
+        self._batches_done += 1
+        if self._batches_done % self._WC_SYNC == 0:
+            self._flush_word_count()
+            global_words = self._wc_table.get([0])[0]
+            # Take the max: the global clock includes our own pushes and
+            # every peer's; between syncs we advance locally.
+            self.trained_words = max(self.trained_words, int(global_words))
+
+    # -- public API --
+    def train_batch(self, batch) -> float:
+        loss = self._finish(self._launch(self._prepare(batch)))
+        self._drain_pushes()
+        return loss
 
     def train_batch_async(self, batch):
-        # The PS path must push/pull around every step; there is no
-        # fire-and-forget variant (the pull is the synchronization point).
         return jnp.float32(self.train_batch(batch))
 
-    def train_batch(self, batch) -> float:
-        old_in, old_out = self._emb_in, self._emb_out
-        # Base-class async step explicitly: self.train_batch_async is the
-        # PS wrapper above and would recurse.
-        loss = float(Word2Vec.train_batch_async(self, batch)) \
-            / max(batch.count, 1)
-        scale = 1.0 / self._num_workers
-        delta_in = np.asarray((self._emb_in - old_in) * scale)
-        delta_out = np.asarray((self._emb_out - old_out) * scale)
-        rows_in = np.unique(np.asarray(
-            batch.centers if not isinstance(batch, CbowBatch)
-            else batch.window)).astype(np.int32)
-        rows_in = rows_in[rows_in >= 0]
-        self._in_table.add_rows_async(rows_in, delta_in[rows_in])
-        rows_out = np.nonzero(np.abs(delta_out).sum(axis=1))[0] \
-            .astype(np.int32)
-        if rows_out.size:
-            self._out_table.add_rows_async(rows_out, delta_out[rows_out])
-        self._wc_table.add([0], [float(batch.words)])
-        # Refresh from the server so other workers' updates land.
-        self._pull_full()
-        global_words = self._wc_table.get([0])[0]
-        self.trained_words = int(global_words)
-        return loss
+    def train_batches(self, iterator) -> Tuple[float, int]:
+        """Pipelined loop: batch i+1's row pull is serviced by the server
+        actors while batch i's step runs on device and its deltas push
+        (ref overlap: distributed_wordembedding.cpp:203-224)."""
+        loss_sum = 0.0
+        pairs = 0
+        launched: Optional[_Launched] = None
+        for batch in iterator:
+            prep = self._prepare(batch)  # async pull in flight
+            if launched is not None:
+                loss_sum += self._finish(launched) \
+                    * max(launched.prep.batch.count, 1)
+                pairs += launched.prep.batch.count
+            launched = self._launch(prep)
+        if launched is not None:
+            loss_sum += self._finish(launched) \
+                * max(launched.prep.batch.count, 1)
+            pairs += launched.prep.batch.count
+        # Every push acked, trailing word count published, then the
+        # barrier: a peer's post-barrier read sees all of our updates.
+        self._drain_pushes()
+        self._flush_word_count()
+        self._in_table.zoo.barrier()
+        return loss_sum, pairs
+
+    @property
+    def embeddings(self) -> np.ndarray:
+        self._drain_pushes()
+        return self._in_table.get()

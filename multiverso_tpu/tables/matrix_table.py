@@ -129,7 +129,12 @@ class MatrixWorker(WorkerTable):
             out = np.empty((row_ids.size, self.num_col), self.dtype)
         CHECK(out.shape == (row_ids.size, self.num_col), "bad output shape")
         self._dest = out
-        self._dest_rows = {int(r): i for i, r in enumerate(row_ids)}
+        # A row id may appear more than once (e.g. power-of-two padded row
+        # sets repeat the last id); every requested position must be
+        # filled, not just the last.
+        self._dest_rows = {}
+        for i, r in enumerate(row_ids):
+            self._dest_rows.setdefault(int(r), []).append(i)
         self._device_shards = None
         return self._request_get(Blob(row_ids.view(np.uint8)))
 
@@ -248,7 +253,8 @@ class MatrixWorker(WorkerTable):
             self._dest[keys] = values
         else:
             for i, key in enumerate(keys):
-                self._dest[self._dest_rows[int(key)]] = values[i]
+                for pos in self._dest_rows[int(key)]:
+                    self._dest[pos] = values[i]
 
 
 class MatrixServer(ServerTable):

@@ -106,6 +106,13 @@ class WorkerTable:
             waiter = self._waitings.get(msg_id)
         if waiter is not None:
             waiter.notify()
+            if waiter.done:
+                # Reap completed waiters here, not only in wait():
+                # fire-and-forget async adds (never waited) would otherwise
+                # leak one Waiter per request over a long run.
+                with self._mutex:
+                    if self._waitings.get(msg_id) is waiter and waiter.done:
+                        self._waitings.pop(msg_id, None)
 
     # -- virtuals (ref: table_interface.h:44-51) --
     def partition(self, blobs: List[Blob],

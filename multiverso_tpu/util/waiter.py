@@ -35,6 +35,11 @@ class Waiter:
             if self._num_wait <= 0:
                 self._cond.notify_all()
 
+    @property
+    def done(self) -> bool:
+        with self._mutex:
+            return self._num_wait <= 0
+
     def reset(self, num_wait: int) -> None:
         with self._cond:
             self._num_wait = num_wait

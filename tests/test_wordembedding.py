@@ -210,6 +210,87 @@ class TestPSWord2Vec:
             mv.shutdown()
         assert sep > 0.3, f"separation {sep}"
 
+    @pytest.mark.parametrize("mode", ["cbow", "hs"])
+    def test_ps_compact_step_modes(self, tmp_path, mode):
+        # CBOW and hierarchical softmax through the compact pulled-row
+        # step (the PS redesign trains on [R, D] row sets, not V x D).
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        mv.init([])
+        try:
+            kw = dict(cbow=True) if mode == "cbow" \
+                else dict(hs=True, negative=0)
+            config = Word2VecConfig(embedding_size=16, window=3, epochs=5,
+                                    init_learning_rate=0.01,
+                                    batch_size=1024, sample=0, use_ps=True,
+                                    **kw)
+            model = PSWord2Vec(config, d)
+            for epoch in range(config.epochs):
+                loss_sum, pairs = model.train_batches(iter_pair_batches(
+                    d, str(path), batch_size=1024, window=3, subsample=0,
+                    cbow=config.cbow, seed=epoch))
+                assert np.isfinite(loss_sum) and pairs > 0
+            sep = topic_separation(model, d)
+        finally:
+            mv.shutdown()
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_ps_pulls_are_row_sparse(self, tmp_path):
+        # The PS path must pull only the rows a batch touches — never the
+        # whole table (the round-1 design pulled V x D per batch).
+        rng = np.random.default_rng(3)
+        vocab = [f"w{i}" for i in range(600)]
+        path = tmp_path / "corpus.txt"
+        path.write_text("\n".join(
+            " ".join(rng.choice(vocab, size=10)) for _ in range(400)))
+        d = Dictionary.build(str(path), min_count=1)
+        mv.init([])
+        try:
+            config = Word2VecConfig(embedding_size=8, window=2, epochs=1,
+                                    batch_size=128, sample=0, use_ps=True)
+            model = PSWord2Vec(config, d)
+            pulled = []
+            orig = model._in_table.get_rows_async
+
+            def spy(rows, out=None):
+                pulled.append(len(rows))
+                return orig(rows, out=out)
+
+            model._in_table.get_rows_async = spy
+            loss_sum, pairs = model.train_batches(iter_pair_batches(
+                d, str(path), batch_size=128, window=2, subsample=0))
+            assert pairs > 0 and np.isfinite(loss_sum)
+            assert pulled, "no row pulls recorded"
+            # 128 pairs touch at most 128 input rows (padded to a power of
+            # two) out of a 600-word vocab.
+            assert max(pulled) <= 128 < d.size, pulled
+        finally:
+            mv.shutdown()
+
+    def test_ps_two_workers_cluster(self, tmp_path):
+        # Two virtual ranks train concurrently against shared tables:
+        # delta scaling (1/num_workers) and concurrent row pulls/pushes.
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+
+        def body(rank):
+            d = Dictionary.build(str(path), min_count=1)
+            config = Word2VecConfig(embedding_size=16, window=3, epochs=3,
+                                    init_learning_rate=0.005,
+                                    batch_size=1024, sample=0, use_ps=True)
+            model = PSWord2Vec(config, d)
+            for epoch in range(config.epochs):
+                model.train_batches(iter_pair_batches(
+                    d, str(path), batch_size=1024, window=3, subsample=0,
+                    seed=100 * rank + epoch))
+            mv.current_zoo().barrier()
+            return topic_separation(model, d)
+
+        seps = LocalCluster(2).run(body)
+        assert all(s > 0.3 for s in seps), seps
+
     def test_ps_word_count_drives_lr(self, tmp_path):
         path = tmp_path / "corpus.txt"
         write_topic_corpus(path, n_sentences=100)
