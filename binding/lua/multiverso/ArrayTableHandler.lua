@@ -1,0 +1,58 @@
+--- ArrayTableHandler: 1-D float table client.
+--
+-- Public surface of the reference handler (ref: binding/lua/
+-- ArrayTableHandler.lua: new/get/add with init_value master-add
+-- convention) as a plain-metatable class; returns Lua tables (or keeps
+-- torch tensors out of the core path entirely).
+
+local ffi = require 'ffi'
+local util = require 'multiverso.util'
+
+ffi.cdef[[
+    void MV_NewArrayTable(int size, TableHandler* out);
+    void MV_GetArrayTable(TableHandler handler, float* data, int size);
+    void MV_AddArrayTable(TableHandler handler, float* data, int size);
+    void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+]]
+
+local ArrayTableHandler = {}
+ArrayTableHandler.__index = ArrayTableHandler
+
+--- new(size, init_value): create the table; when init_value is given the
+-- master worker (id 0) adds it and every other worker adds zeros — the
+-- reference's init convention, so sync mode stays balanced.
+function ArrayTableHandler:new(size, init_value)
+    local self_ = setmetatable({}, ArrayTableHandler)
+    self_._size = size
+    self_._handler = ffi.new('TableHandler[1]')
+    libmv.MV_NewArrayTable(ffi.new('int', size), self_._handler)
+    if init_value ~= nil then
+        local mv = require 'multiverso.init'
+        if mv.worker_id() == 0 then
+            self_:add(init_value, true)
+        else
+            local zeros = {}
+            for i = 1, size do zeros[i] = 0 end
+            self_:add(zeros, true)
+        end
+    end
+    return self_
+end
+
+function ArrayTableHandler:get()
+    local cdata = ffi.new('float[?]', self._size)
+    libmv.MV_GetArrayTable(self._handler[0], cdata, self._size)
+    return util.to_table(cdata, self._size)
+end
+
+function ArrayTableHandler:add(data, sync)
+    local cdata, keep = util.to_cdata(data, self._size)
+    if sync then
+        libmv.MV_AddArrayTable(self._handler[0], cdata, self._size)
+    else
+        libmv.MV_AddAsyncArrayTable(self._handler[0], cdata, self._size)
+    end
+    return keep ~= nil  -- anchor: keep cdata alive through the call
+end
+
+return ArrayTableHandler

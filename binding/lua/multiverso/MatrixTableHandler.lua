@@ -1,0 +1,88 @@
+--- MatrixTableHandler: 2-D row-addressable float table client.
+--
+-- Public surface of the reference handler (ref: binding/lua/
+-- MatrixTableHandler.lua: new/get/add with optional row_ids) over the
+-- c_api's whole-table and by-rows entry points.
+
+local ffi = require 'ffi'
+local util = require 'multiverso.util'
+
+ffi.cdef[[
+    void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+    void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data,
+                                   int size);
+    void MV_GetMatrixTableByRows(TableHandler handler, float* data,
+                                 int size, int* row_ids, int row_ids_n);
+    void MV_AddMatrixTableByRows(TableHandler handler, float* data,
+                                 int size, int* row_ids, int row_ids_n);
+    void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data,
+                                      int size, int* row_ids,
+                                      int row_ids_n);
+]]
+
+local MatrixTableHandler = {}
+MatrixTableHandler.__index = MatrixTableHandler
+
+function MatrixTableHandler:new(num_row, num_col, init_value)
+    local self_ = setmetatable({}, MatrixTableHandler)
+    self_._num_row, self_._num_col = num_row, num_col
+    self_._size = num_row * num_col
+    self_._handler = ffi.new('TableHandler[1]')
+    libmv.MV_NewMatrixTable(ffi.new('int', num_row),
+                            ffi.new('int', num_col), self_._handler)
+    if init_value ~= nil then
+        local mv = require 'multiverso.init'
+        if mv.worker_id() == 0 then
+            self_:add(init_value, nil, true)
+        else
+            local zeros = {}
+            for i = 1, self_._size do zeros[i] = 0 end
+            self_:add(zeros, nil, true)
+        end
+    end
+    return self_
+end
+
+--- get(row_ids): whole table as a flat row-major table, or just the
+-- requested rows (concatenated) when row_ids is given.
+function MatrixTableHandler:get(row_ids)
+    if row_ids == nil then
+        local cdata = ffi.new('float[?]', self._size)
+        libmv.MV_GetMatrixTableAll(self._handler[0], cdata, self._size)
+        return util.to_table(cdata, self._size)
+    end
+    local n = #row_ids * self._num_col
+    local cdata = ffi.new('float[?]', n)
+    local ids = util.to_int_cdata(row_ids)
+    libmv.MV_GetMatrixTableByRows(self._handler[0], cdata, n, ids,
+                                  #row_ids)
+    return util.to_table(cdata, n)
+end
+
+--- add(data, row_ids, sync): whole-table or by-rows delta add.
+function MatrixTableHandler:add(data, row_ids, sync)
+    if row_ids == nil then
+        local cdata = util.to_cdata(data, self._size)
+        if sync then
+            libmv.MV_AddMatrixTableAll(self._handler[0], cdata, self._size)
+        else
+            libmv.MV_AddAsyncMatrixTableAll(self._handler[0], cdata,
+                                            self._size)
+        end
+        return
+    end
+    local n = #row_ids * self._num_col
+    local cdata = util.to_cdata(data, n)
+    local ids = util.to_int_cdata(row_ids)
+    if sync then
+        libmv.MV_AddMatrixTableByRows(self._handler[0], cdata, n, ids,
+                                      #row_ids)
+    else
+        libmv.MV_AddAsyncMatrixTableByRows(self._handler[0], cdata, n,
+                                           ids, #row_ids)
+    end
+end
+
+return MatrixTableHandler

@@ -1,0 +1,74 @@
+--- multiverso: LuaJIT FFI binding over the libmultiverso c_api.
+--
+-- Drop-in module layout and public surface of the reference binding
+-- (ref: binding/lua/init.lua:1-67) on top of this framework's ABI-
+-- compatible shim (native/c_api/multiverso_c_api.cpp). Re-implemented:
+-- torch is optional (plain Lua tables work), the library is located via
+-- MULTIVERSO_LIB / package.cpath / the in-repo build dir, and handlers
+-- are plain metatables instead of torch classes.
+
+local ffi = require 'ffi'
+
+local mv = {}
+
+ffi.cdef[[
+    typedef void* TableHandler;
+    void MV_Init(int* argc, char* argv[]);
+    void MV_ShutDown();
+    void MV_Barrier();
+    int MV_NumWorkers();
+    int MV_WorkerId();
+    int MV_ServerId();
+]]
+
+local function locate_lib()
+    local env = os.getenv('MULTIVERSO_LIB')
+    if env ~= nil then return env end
+    local here = debug.getinfo(1, 'S').source:match('@?(.*/)') or './'
+    local candidates = {
+        here .. '../../../native/build/libmultiverso.so',
+        'libmultiverso.so',
+    }
+    for _, path in ipairs(candidates) do
+        local f = io.open(path, 'r')
+        if f ~= nil then f:close(); return path end
+    end
+    package.cpath = '/usr/local/lib/?.so;' .. package.cpath
+    return package.searchpath('libmultiverso', package.cpath, '')
+end
+
+local libpath = locate_lib()
+if libpath == nil then
+    error('libmultiverso.so not found: set MULTIVERSO_LIB or build ' ..
+          'native/ (make -C native)')
+end
+-- Global export: RTLD_GLOBAL so the embedded runtime resolves.
+libmv = ffi.load(libpath, true)
+mv._lib = libmv
+
+mv.ArrayTableHandler = require('multiverso.ArrayTableHandler')
+mv.MatrixTableHandler = require('multiverso.MatrixTableHandler')
+
+--- init(sync): MV_Init with an optional -sync=true flag.
+function mv.init(sync)
+    local args = { 'lua' }
+    if sync then args[#args + 1] = '-sync=true' end
+    local argc = ffi.new('int[1]', #args)
+    local argv = ffi.new('char*[?]', #args)
+    local keep = {}
+    for i = 1, #args do
+        local buf = ffi.new('char[?]', #args[i] + 1)
+        ffi.copy(buf, args[i])
+        argv[i - 1] = buf
+        keep[i] = buf
+    end
+    libmv.MV_Init(argc, argv)
+end
+
+function mv.shutdown() libmv.MV_ShutDown() end
+function mv.barrier() libmv.MV_Barrier() end
+function mv.num_workers() return libmv.MV_NumWorkers() end
+function mv.worker_id() return libmv.MV_WorkerId() end
+function mv.server_id() return libmv.MV_ServerId() end
+
+return mv

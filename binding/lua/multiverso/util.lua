@@ -1,0 +1,58 @@
+--- Conversion helpers: Lua tables / torch tensors <-> C float arrays.
+--
+-- Equivalent role to the reference's util.lua (ref: binding/lua/
+-- util.lua:17-27) but torch-optional: anything exposing :data() and
+-- :nElement() (a torch tensor) is used zero-copy-ish via its contiguous
+-- buffer; plain Lua (possibly nested) tables are flattened.
+
+local ffi = require 'ffi'
+
+local util = {}
+
+local function flatten(t, out)
+    for i = 1, #t do
+        local v = t[i]
+        if type(v) == 'table' then
+            flatten(v, out)
+        else
+            out[#out + 1] = v
+        end
+    end
+    return out
+end
+
+--- to_cdata(data, n): float[n] cdata from a table or torch tensor.
+function util.to_cdata(data, n)
+    if type(data) ~= 'table' and data.data ~= nil then
+        -- torch tensor: contiguous float buffer
+        local ft = data:contiguous():float()
+        return ft:data(), ft
+    end
+    local flat = flatten(data, {})
+    n = n or #flat
+    local cdata = ffi.new('float[?]', n)
+    for i = 1, math.min(#flat, n) do
+        cdata[i - 1] = flat[i]
+    end
+    return cdata, cdata
+end
+
+--- to_table(cdata, n): Lua array table from float* cdata.
+function util.to_table(cdata, n)
+    local out = {}
+    for i = 1, n do
+        out[i] = tonumber(cdata[i - 1])
+    end
+    return out
+end
+
+--- to_int_cdata(list): int[n] cdata from a Lua table.
+function util.to_int_cdata(list)
+    local cdata = ffi.new('int[?]', #list)
+    for i = 1, #list do
+        cdata[i - 1] = list[i]
+    end
+    return cdata
+end
+
+return util

@@ -1,0 +1,58 @@
+--- Binding test: array + matrix roundtrips through libmultiverso.so.
+--
+-- Non-interactive re-design of the reference's torch TestSuite
+-- (ref: binding/lua/test.lua): plain asserts, exit 0 on success.
+-- Run: luajit test.lua   (from binding/lua/, with native/ built)
+
+package.path = './?.lua;./?/init.lua;' .. package.path
+
+local mv = require 'multiverso'
+
+mv.init()
+assert(mv.num_workers() >= 1, 'no workers')
+assert(mv.worker_id() >= 0, 'bad worker id')
+
+-- Array roundtrip: two sync adds accumulate.
+local size = 1000
+local abh = mv.ArrayTableHandler:new(size)
+mv.barrier()
+local ones = {}
+for i = 1, size do ones[i] = 1 end
+abh:add(ones, true)
+abh:add(ones, true)
+local got = abh:get()
+assert(#got == size, 'bad get size: ' .. #got)
+assert(got[1] == 2 and got[size] == 2,
+       'array add/get mismatch: ' .. got[1])
+
+-- init_value convention: master lands it exactly once.
+local init = {}
+for i = 1, size do init[i] = i end
+local abh2 = mv.ArrayTableHandler:new(size, init)
+mv.barrier()
+local got2 = abh2:get()
+assert(got2[7] == 7, 'init_value mismatch: ' .. got2[7])
+
+-- Matrix whole-table + by-rows.
+local rows, cols = 11, 10
+local mbh = mv.MatrixTableHandler:new(rows, cols)
+mv.barrier()
+local flat = {}
+for i = 1, rows * cols do flat[i] = i end
+mbh:add(flat, nil, true)
+local all = mbh:get()
+assert(all[1] == 1 and all[rows * cols] == rows * cols,
+       'matrix whole add/get mismatch')
+local some = mbh:get({ 0, 5, 10 })
+assert(#some == 3 * cols, 'bad by-rows size')
+assert(some[1] == 1, 'row 0 mismatch: ' .. some[1])
+assert(some[cols + 1] == 5 * cols + 1, 'row 5 mismatch')
+local delta = {}
+for i = 1, 2 * cols do delta[i] = 1 end
+mbh:add(delta, { 1, 3 }, true)
+local row13 = mbh:get({ 1, 3 })
+assert(row13[1] == cols + 2, 'by-rows add mismatch: ' .. row13[1])
+
+mv.barrier()
+mv.shutdown()
+print('LUA_BINDING_OK')

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# CI gate — the repo's equivalent of the reference's Docker test list
+# (ref: deploy/docker/Dockerfile:94-113: build, unit tests, binding
+# tests, mpirun -np 4 integration tests). Runnable locally and from CI.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== build native (c_api shim) from source =="
+make -C native clean
+make -C native
+
+echo "== unit + in-process integration tests =="
+# Virtual 8-device CPU mesh (tests/conftest.py forces the platform).
+python -m pytest tests/ -x -q --ignore=tests/test_net_integration.py
+
+echo "== multi-process TCP integration (the mpirun -np 4 equivalent) =="
+python -m pytest tests/test_net_integration.py -x -q
+
+echo "== c_api ABI through ctypes (+ Lua when a runtime exists) =="
+python -m pytest tests/test_binding.py -x -q
+
+echo "== driver entry points =="
+python -c "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI OK"

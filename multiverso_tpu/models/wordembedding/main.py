@@ -78,10 +78,12 @@ def run(argv=None) -> Word2Vec:
             dictionary, corpus, batch_size=config.batch_size,
             window=config.window, subsample=config.sample,
             cbow=config.cbow, seed=config.seed + epoch)
-        iterator = BlockLoader(batches) if get_flag("is_pipeline") \
-            else batches
-        # Hot loop lives in the model: local mode accumulates device
-        # losses without host syncs; PS mode pipelines pull/train/push.
+        # Row preparation runs in the loader thread (prepared()) so it
+        # overlaps with device steps; the hot loop lives in the model —
+        # local mode accumulates device losses without host syncs, PS
+        # mode pipelines pull/train/push.
+        iterator = BlockLoader(model.prepared(batches)) \
+            if get_flag("is_pipeline") else batches
         loss_sum, pair_count = model.train_batches(iterator)
         elapsed = time.perf_counter() - start
         log.info("epoch %d: avg pair loss %.4f, %.0f words/s", epoch,

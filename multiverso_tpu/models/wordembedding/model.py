@@ -2,41 +2,45 @@
 
 TPU-native re-design of the reference's WordEmbedding compute core
 (ref: Applications/WordEmbedding/src/wordembedding.cpp — per-window scalar
-FeedForward/BPOutputLayer loops): here one jitted step trains a whole
-batch of (center, context) pairs on the MXU —
+FeedForward/BPOutputLayer loops): one jitted step trains a whole batch of
+(center, context) pairs on the MXU.
 
-- negative sampling (SGNS): negatives are drawn inside the jit by
-  inverse-CDF over the unigram^0.75 distribution; logits are a gathered
-  batched dot product ``einsum('bd,bkd->bk')`` over [positive, K
-  negatives]; gradients scatter-add into both embedding matrices;
-- hierarchical softmax: each pair trains the Huffman path of the context
-  word — codes/points are gathered from device-resident [V, L] tables
-  (built by huffman.py) and padded path slots are masked;
-- CBOW averages the (padded, masked) context window into the input vector
-  and scatters the input gradient back to every window word.
+The central design decision (round 2): **both** the local and the
+parameter-server trainer work on COMPACT row sets. A host-side
+preparation pass computes the unique embedding rows a batch touches
+(input rows from centers/window words; output rows from targets plus
+host-sampled negatives or Huffman path nodes) and remaps batch indices
+to compact slots. Then:
 
-Embeddings are plain device arrays locally; the PS variant keeps them in
-row-sharded matrix tables and trains blocks on pulled rows, pushing
-``(new - old) / num_workers`` exactly like the reference's
-AddDeltaParameter (ref: communicator.cpp:157-249).
+- **local mode**: one jitted step gathers those rows from the full
+  device tables, trains the compact [R, D] matrices, and scatter-adds
+  the updates back — donated buffers, HBM traffic O(batch). (The naive
+  formulation differentiates through the full V x D tables and makes
+  every step O(vocab) in memory traffic: at 1M+ vocab that is ~GBs per
+  batch and dominates wall clock.)
+- **PS mode**: the same prepared row sets drive row-sparse table pulls,
+  the same compact loss trains the pulled rows, and row deltas
+  ``(new - old) / num_workers`` push back (ref: communicator.cpp:
+  117-249), pipelined across batches (ref: distributed_wordembedding.
+  cpp:203-224).
 
-The learning rate decays linearly in processed words:
-``lr = initial * max(1 - done/total, 1e-4)`` (ref:
-distributed_wordembedding.cpp:92-134 recomputes it from the global word
-count; in distributed mode that count lives in a KV table).
-"""
+Negatives are host-sampled by inverse-CDF over the unigram^0.75
+distribution in float64 (the row set must be known before the device
+step; float32 CDF tails can round below 1.0 and index past the vocab).
+The learning rate decays linearly in processed words (ref:
+distributed_wordembedding.cpp:92-134; in PS mode the global count rides
+a KV table)."""
 
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ... import create_kv_table, create_matrix_table
-from ...updater.engine import pad_ids
 from .data import CbowBatch, PairBatch
 from .dictionary import Dictionary
 from .huffman import build_huffman
@@ -70,50 +74,85 @@ class Word2VecConfig:
         self.use_ps = use_ps
 
 
-class Word2Vec:
-    """Local (single-process) trainer; device-resident embeddings."""
+def _pad_rows(rows: np.ndarray, minimum: int = 8) -> np.ndarray:
+    """Pad a sorted unique row-id set to the next power of two (bounded
+    set of jit trace shapes) by repeating the last id. Padded slots are
+    never referenced by the compact index maps, so they receive zero
+    gradient; local scatter-adds of zero are no-ops and PS delta pushes
+    slice them off."""
+    n = max(int(rows.size), 1)
+    target = max(minimum, 1 << (n - 1).bit_length())
+    if rows.size == 0:
+        return np.zeros(target, np.int32)
+    if rows.size == target:
+        return rows
+    return np.concatenate(
+        [rows, np.full(target - rows.size, rows[-1], np.int32)])
 
-    _DONATE = True  # PS subclass keeps old params to form wire deltas
+
+class CompactBatch:
+    """Host-prepared batch: unique touched rows + compact index maps.
+
+    ``rows_in``/``rows_out`` are the real (unpadded) sorted unique row
+    sets; ``rows_in_p``/``rows_out_p`` the power-of-two padded versions
+    the device step uses; ``in_args``/``out_args`` index into the padded
+    compact arrays."""
+
+    __slots__ = ("rows_in", "rows_out", "rows_in_p", "rows_out_p",
+                 "in_args", "out_args", "count", "words", "size")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class Word2Vec:
+    """Local (single-process) trainer; device-resident embeddings,
+    compact-row update steps."""
 
     def __init__(self, config: Word2VecConfig, dictionary: Dictionary):
         self.config = config
         self.dictionary = dictionary
+        self._dim = config.embedding_size
         self._out_rows = self._init_output_structures()
-        self._key = jax.random.PRNGKey(config.seed)
+        self._rng = np.random.default_rng(config.seed + 13)
         self.trained_words = 0
         self.total_words = dictionary.total_count * config.epochs
         self._init_embeddings()
 
     def _init_output_structures(self) -> int:
-        """Huffman tables (hs) or the unigram^0.75 CDF (sgns); returns the
-        output-embedding row count. Host copies back the PS row-set
-        preparation (which must know the touched output rows before the
-        device step runs)."""
+        """Huffman tables (hs) or the unigram^0.75 CDF (sgns); returns
+        the output-embedding row count. All host-side: row-set
+        preparation must know the touched output rows before the device
+        step runs."""
         config, dictionary = self.config, self.dictionary
         if config.hs:
             tree = build_huffman(dictionary.counts)
             self._codes_host = np.asarray(tree.codes)
             self._points_host = np.asarray(tree.points)
-            self._codes = jnp.asarray(tree.codes)
-            self._points = jnp.asarray(tree.points)
             return max(tree.num_inner_nodes, 1)
         neg = dictionary.negative_table()
-        # float64 accumulation: a float32 cumsum's last entry lands
-        # measurably below 1.0 and uniform draws above it would index one
-        # past the last word.
+        # float64: a float32 cumsum's last entry can land below 1.0 and
+        # a uniform draw above it would index one past the last word.
         self._neg_cdf_host = np.cumsum(neg, dtype=np.float64)
-        self._neg_cdf = jnp.asarray(self._neg_cdf_host)
         return dictionary.size
 
     def _init_embeddings(self) -> None:
         """Local mode: full device-resident matrices. ref init: uniform
-        (-0.5/dim, 0.5/dim) input, zeros output. The PS subclass overrides
-        this with table creation (no full local copies)."""
+        (-0.5/dim, 0.5/dim) input, zeros output. The PS subclass
+        overrides this with table creation (no full local copies)."""
         vocab, dim = self.dictionary.size, self.config.embedding_size
         rng = np.random.default_rng(self.config.seed)
         self._emb_in = jnp.asarray(
             (rng.random((vocab, dim)) - 0.5) / dim, jnp.float32)
         self._emb_out = jnp.zeros((self._out_rows, dim), jnp.float32)
+        if self.config.hs:
+            self._codes_dev = jnp.asarray(self._codes_host)
+            self._points_dev = jnp.asarray(self._points_host)
+        else:
+            self._neg_cdf_dev = jnp.asarray(
+                self._neg_cdf_host.astype(np.float32))
+        self._key = jax.random.PRNGKey(self.config.seed)
         self._step = self._build_step()
 
     # -- learning rate schedule --
@@ -122,81 +161,183 @@ class Word2Vec:
                      1e-4)
         return self.config.init_learning_rate * remain
 
-    # -- the fused train step --
+    # -- host preparation: batch -> compact row sets + index maps --
+    def prepare(self, batch) -> CompactBatch:
+        """Compute the rows this batch touches and remap its indices to
+        compact slots (the reference's per-block row collection,
+        ref: communicator.cpp:117-155). Pure numpy — run it in the
+        loader thread to overlap with device steps."""
+        config = self.config
+        if isinstance(batch, CbowBatch):
+            win, targets = batch.window, batch.centers
+            real = win[win >= 0]
+            rows_in = np.unique(real).astype(np.int32) if real.size \
+                else np.zeros(1, np.int32)
+            win_l = np.clip(np.searchsorted(rows_in, np.maximum(win, 0)),
+                            0, rows_in.size - 1).astype(np.int32)
+            in_args = (win_l, (win >= 0).astype(np.float32))
+            size = batch.centers.shape[0]
+        else:
+            centers, targets = batch.centers, batch.contexts
+            rows_in = np.unique(centers).astype(np.int32)
+            in_args = (np.searchsorted(rows_in, centers).astype(np.int32),)
+            size = centers.shape[0]
+
+        if config.hs:
+            points = self._points_host[targets]  # [B, L], -1 padded
+            real = points[points >= 0]
+            rows_out = np.unique(real).astype(np.int32) if real.size \
+                else np.zeros(1, np.int32)
+            points_l = np.clip(
+                np.searchsorted(rows_out, np.maximum(points, 0)),
+                0, rows_out.size - 1).astype(np.int32)
+            out_args = (points_l, self._codes_host[targets])
+        else:
+            k = config.negative
+            neg = np.minimum(
+                np.searchsorted(self._neg_cdf_host,
+                                self._rng.random((targets.size, k))),
+                self.dictionary.size - 1).astype(np.int32)
+            rows_out = np.unique(
+                np.concatenate([targets, neg.reshape(-1)])).astype(np.int32)
+            out_args = (np.searchsorted(rows_out, targets).astype(np.int32),
+                        np.searchsorted(rows_out, neg).astype(np.int32))
+
+        return CompactBatch(
+            rows_in=rows_in, rows_out=rows_out,
+            rows_in_p=_pad_rows(rows_in), rows_out_p=_pad_rows(rows_out),
+            in_args=in_args, out_args=out_args,
+            count=batch.count, words=batch.words, size=size)
+
+    # -- the shared compact loss --
+    def _compact_loss(self):
+        config = self.config
+
+        def input_vec(ein, in_args):
+            if config.cbow:
+                win_l, win_mask = in_args
+                vecs = ein[win_l] * win_mask[..., None]
+                denom = jnp.maximum(win_mask.sum(axis=1, keepdims=True),
+                                    1.0)
+                return vecs.sum(axis=1) / denom
+            (centers_l,) = in_args
+            return ein[centers_l]
+
+        if config.hs:
+            def loss_fn(ein, eout, in_args, out_args, pair_mask):
+                """Hierarchical softmax over the target's Huffman path;
+                code 0 = positive class — the word2vec convention
+                (ref: wordembedding.cpp HS branch)."""
+                v = input_vec(ein, in_args)
+                points_l, codes = out_args
+                mask = (codes >= 0).astype(jnp.float32) * pair_mask[:, None]
+                u = eout[points_l]  # [B, L, D]
+                logits = jnp.clip(jnp.einsum("bd,bld->bl", v, u),
+                                  -_MAX_EXP, _MAX_EXP)
+                labels = 1.0 - codes.astype(jnp.float32)
+                return jnp.sum(_sigmoid_xent(logits, labels * mask) * mask)
+        else:
+            k = config.negative
+
+            def loss_fn(ein, eout, in_args, out_args, pair_mask):
+                """SGNS. The MAX_EXP clamp is word2vec's sigmoid table:
+                saturated pairs get ZERO gradient. SUM over the batch:
+                word2vec applies the learning rate per pair; a mean
+                would shrink the per-pair step by the batch size."""
+                v = input_vec(ein, in_args)
+                targets_l, negs_l = out_args
+                cols = jnp.concatenate([targets_l[:, None], negs_l], axis=1)
+                u = eout[cols]  # [B, 1+K, D]
+                logits = jnp.clip(jnp.einsum("bd,bkd->bk", v, u),
+                                  -_MAX_EXP, _MAX_EXP)
+                batch = v.shape[0]
+                labels = jnp.concatenate(
+                    [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
+                return jnp.sum(_sigmoid_xent(logits, labels)
+                               * pair_mask[:, None])
+
+        return loss_fn
+
+    # -- the fused local train step: gather -> train -> scatter-add.
+    #
+    # The batch only ships its (center, context) ids (negatives sample
+    # in-jit); gradients are taken w.r.t. the GATHERED rows and
+    # scatter-added back at the global ids — duplicate ids sum, which is
+    # exactly the dense-gradient semantics — so HBM traffic per step is
+    # O(batch), not O(vocab). (Differentiating through the full V x D
+    # tables rewrites both tables every step: ~GBs of traffic per batch
+    # at 1M+ vocab, which is what capped round-1 scaling.)
     def _build_step(self):
         config = self.config
-        if config.hs:
-            pair_loss = self._hs_pair_loss
-        else:
-            pair_loss = self._neg_pair_loss
+        k = config.negative
 
-        # ``pair_mask`` zeroes the tail-batch padding rows — without it the
-        # padded (0, 0) pairs would train the most frequent word against
-        # itself as a positive example.
-        if config.cbow:
-            def loss_fn(params, window, centers, pair_mask, key):
-                emb_in, emb_out = params
+        def gather_input(emb_in, in_ids):
+            if config.cbow:
+                window = in_ids  # [B, 2W], -1 padded
                 mask = (window >= 0).astype(jnp.float32)
-                safe = jnp.maximum(window, 0)
-                vecs = emb_in[safe] * mask[..., None]
+                vecs = emb_in[jnp.maximum(window, 0)] * mask[..., None]
                 denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
-                v = vecs.sum(axis=1) / denom  # [B, D] averaged window
-                return pair_loss(v, centers, emb_out, pair_mask, key)
-        else:
-            def loss_fn(params, centers, contexts, pair_mask, key):
-                emb_in, emb_out = params
-                v = emb_in[centers]
-                return pair_loss(v, contexts, emb_out, pair_mask, key)
+                return vecs, lambda g: g  # grads flow per window word
+            return emb_in[in_ids], None
 
-        def step(params, lr, key, pair_mask, *batch):
-            loss, grads = jax.value_and_grad(
-                lambda p: loss_fn(p, *batch, pair_mask, key))(params)
-            new_params = jax.tree_util.tree_map(
-                lambda p, g: p - lr * g, params, grads)
-            return new_params, loss
+        def step(emb_in, emb_out, lr, key, pair_mask, in_ids, targets):
+            if config.hs:
+                points = self._points_dev[targets]  # [B, L]
+                codes = self._codes_dev[targets]
+                out_ids = jnp.maximum(points, 0)
+                out_mask = (codes >= 0).astype(jnp.float32) \
+                    * pair_mask[:, None]
+                labels = (1.0 - codes.astype(jnp.float32)) * out_mask
+            else:
+                batch = targets.shape[0]
+                uniform = jax.random.uniform(key, (batch, k))
+                negs = jnp.minimum(
+                    jnp.searchsorted(self._neg_cdf_dev, uniform),
+                    self._neg_cdf_dev.shape[0] - 1)
+                out_ids = jnp.concatenate([targets[:, None], negs], axis=1)
+                out_mask = pair_mask[:, None] * jnp.ones((1, 1 + k))
+                labels = jnp.concatenate(
+                    [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
 
-        return jax.jit(step,
-                       donate_argnums=(0,) if self._DONATE else ())
+            if config.cbow:
+                window = in_ids
+                in_mask = (window >= 0).astype(jnp.float32)
+                in_gather = jnp.maximum(window, 0)
+                vecs = emb_in[in_gather]  # [B, 2W, D]
+            else:
+                in_gather = in_ids
+                vecs = emb_in[in_ids]  # [B, D]
+            u = emb_out[out_ids]  # [B, S, D]
 
-    def _neg_pair_loss(self, v, targets, emb_out, pair_mask, key,
-                       negatives=None):
-        """SGNS: positive target + K negatives — sampled in-jit locally,
-        or host-provided in PS mode (the PS pull needs to know the rows
-        before the step runs, like the reference's block preparation,
-        ref: communicator.cpp:117-155)."""
-        k = self.config.negative
-        batch = v.shape[0]
-        if negatives is None:
-            uniform = jax.random.uniform(key, (batch, k))
-            negatives = jnp.searchsorted(self._neg_cdf, uniform)
-        cols = jnp.concatenate([targets[:, None], negatives], axis=1)
-        u = emb_out[cols]  # [B, 1+K, D]
-        # MAX_EXP clamp, exactly word2vec's sigmoid table: saturated pairs
-        # get ZERO gradient (clip has zero derivative outside the range),
-        # which is what keeps hot rows from diverging under batched sums.
-        logits = jnp.clip(jnp.einsum("bd,bkd->bk", v, u),
-                          -_MAX_EXP, _MAX_EXP)
-        labels = jnp.concatenate(
-            [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
-        losses = _sigmoid_xent(logits, labels) * pair_mask[:, None]
-        # SUM over the batch: word2vec applies the learning rate per pair
-        # (ref trains pair-by-pair); a mean would shrink the per-pair step
-        # by the batch size.
-        return jnp.sum(losses)
+            def loss_fn(vecs, u):
+                if config.cbow:
+                    masked = vecs * in_mask[..., None]
+                    denom = jnp.maximum(
+                        in_mask.sum(axis=1, keepdims=True), 1.0)
+                    v = masked.sum(axis=1) / denom
+                else:
+                    v = vecs
+                logits = jnp.clip(jnp.einsum("bd,bsd->bs", v, u),
+                                  -_MAX_EXP, _MAX_EXP)
+                if config.hs:
+                    losses = _sigmoid_xent(logits, labels) * out_mask
+                else:
+                    losses = _sigmoid_xent(logits, labels) \
+                        * pair_mask[:, None]
+                return jnp.sum(losses)
 
-    def _hs_pair_loss(self, v, targets, emb_out, pair_mask, key):
-        """Hierarchical softmax over the target's Huffman path."""
-        points = self._points[targets]  # [B, L]
-        codes = self._codes[targets]
-        mask = (codes >= 0).astype(jnp.float32) * pair_mask[:, None]
-        u = emb_out[jnp.maximum(points, 0)]  # [B, L, D]
-        logits = jnp.clip(jnp.einsum("bd,bld->bl", v, u),
-                          -_MAX_EXP, _MAX_EXP)  # word2vec MAX_EXP clamp
-        # code 0 = positive class (sigmoid(logit)), 1 = negative — the
-        # word2vec convention (ref: wordembedding.cpp HS branch).
-        labels = 1.0 - codes.astype(jnp.float32)
-        losses = _sigmoid_xent(logits, labels * mask) * mask
-        return jnp.sum(losses)  # per-pair lr semantics, as in SGNS
+            loss, (g_vecs, g_u) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(vecs, u)
+            new_in = emb_in.at[in_gather].add(-lr * g_vecs)
+            new_out = emb_out.at[out_ids].add(-lr * g_u)
+            return new_in, new_out, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _pair_mask_for(self, count: int, size: int):
+        if count == size:
+            return _full_mask(size)
+        return jnp.asarray((np.arange(size) < count).astype(np.float32))
 
     # -- public API --
     def train_batch_async(self, batch):
@@ -204,20 +345,17 @@ class Word2Vec:
         device scalar loss. The hot loop must not materialize per-batch
         scalars — a host fetch per step serializes on device/tunnel
         latency and caps words/sec."""
-        lr = jnp.float32(self.learning_rate())
-        self._key, subkey = jax.random.split(self._key)
-        params = (self._emb_in, self._emb_out)
         if isinstance(batch, CbowBatch):
-            args = (jnp.asarray(batch.window), jnp.asarray(batch.centers))
-            size = batch.centers.shape[0]
+            in_ids, targets = batch.window, batch.centers
         else:
-            args = (jnp.asarray(batch.centers), jnp.asarray(batch.contexts))
-            size = batch.centers.shape[0]
-        pair_mask = _full_mask(size) if batch.count == size \
-            else jnp.asarray((np.arange(size) < batch.count)
-                             .astype(np.float32))
-        (self._emb_in, self._emb_out), loss = self._step(
-            params, lr, subkey, pair_mask, *args)
+            in_ids, targets = batch.centers, batch.contexts
+        size = batch.centers.shape[0]
+        self._key, subkey = jax.random.split(self._key)
+        self._emb_in, self._emb_out, loss = self._step(
+            self._emb_in, self._emb_out,
+            jnp.float32(self.learning_rate()), subkey,
+            self._pair_mask_for(batch.count, size),
+            jnp.asarray(in_ids), jnp.asarray(targets))
         self.trained_words += batch.words
         return loss
 
@@ -228,14 +366,19 @@ class Word2Vec:
     def train_batches(self, iterator) -> Tuple[float, int]:
         """Drive a whole batch stream; returns (loss_sum, pair_count).
         Device losses accumulate without host syncs (one materialization
-        at the end). The PS subclass overrides this with a pipelined
-        pull/train/push loop."""
+        at the end)."""
         losses = []
         pairs = 0
         for batch in iterator:
             losses.append(self.train_batch_async(batch))
             pairs += batch.count
         return float(sum(float(x) for x in losses)), pairs
+
+    def prepared(self, batches):
+        """Adapter for the loader thread. Local mode needs no host
+        preparation (negatives sample in-jit) — identity; the PS
+        subclass overrides with CompactBatch preparation."""
+        return batches
 
     @property
     def embeddings(self) -> np.ndarray:
@@ -264,27 +407,11 @@ def _sigmoid_xent(logits, labels):
         + jnp.log1p(jnp.exp(-jnp.abs(logits)))
 
 
-def _pad_rows(rows: np.ndarray, minimum: int = 8) -> np.ndarray:
-    """Pad a sorted unique row-id set to the next power of two (bounded
-    set of jit trace shapes) by repeating the last id. Padded slots are
-    never referenced by the compact index maps, so their pulled contents
-    and deltas are irrelevant (deltas are sliced off before the push)."""
-    n = max(int(rows.size), 1)
-    target = max(minimum, 1 << (n - 1).bit_length())
-    if rows.size == 0:
-        return np.zeros(target, np.int32)
-    if rows.size == target:
-        return rows
-    return np.concatenate(
-        [rows, np.full(target - rows.size, rows[-1], np.int32)])
-
-
 class _Prep:
-    """One batch's prepared pull: row sets, compact index maps, and the
-    in-flight async Get requests."""
+    """One batch's prepared pull: the CompactBatch plus the in-flight
+    async Get requests and their destination buffers."""
 
-    __slots__ = ("batch", "rows_in", "rows_out", "in_args", "out_args",
-                 "buf_in", "buf_out", "mid_in", "mid_out")
+    __slots__ = ("compact", "buf_in", "buf_out", "mid_in", "mid_out")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -306,22 +433,17 @@ class PSWord2Vec(Word2Vec):
     (ref: Applications/WordEmbedding/src/communicator.cpp:117-249,
     distributed_wordembedding.cpp:203-224):
 
-    - each batch pulls ONLY the embedding rows it touches (input rows =
-      its centers/window words; output rows = its targets plus host-
-      sampled negatives or Huffman path nodes), never the full V x D
-      tables;
-    - the jitted step trains on the compact [R, D] row matrices (batch
-      indices are remapped host-side to compact slots), so step FLOPs and
-      HBM traffic scale with the batch, not the vocabulary;
-    - it pushes ``(new - old) / num_workers`` for exactly those rows;
-    - ``train_batches`` pipelines: while the device runs step i, the next
-      batch's row pull is already in flight through the server actors
-      (the reference's ``-is_pipeline`` prefetch overlap), and the word-
-      count KV traffic is async and amortized over ``_WC_SYNC`` batches
-      (ref: communicator.cpp:251-259 runs it on a side thread).
-    """
+    - each batch pulls ONLY the rows its CompactBatch names — never the
+      full V x D tables;
+    - the shared compact loss trains the pulled [R, D] row matrices;
+    - row deltas ``(new - old) / num_workers`` push back asynchronously,
+      acks drained before any barrier or full-table read;
+    - ``train_batches`` pipelines: batch i+1's pull is serviced by the
+      server actors while batch i's step runs on device;
+    - word-count KV traffic for the lr schedule is async and amortized
+      over ``_WC_SYNC`` batches (ref: communicator.cpp:251-259 runs it
+      on a side thread)."""
 
-    _DONATE = False
     _WC_SYNC = 16  # batches between global word-count syncs
 
     def __init__(self, config: Word2VecConfig, dictionary: Dictionary,
@@ -331,10 +453,9 @@ class PSWord2Vec(Word2Vec):
         zoo = self._in_table.zoo
         self._rng = np.random.default_rng(
             config.seed + 97 * max(zoo.worker_id, 0))
-        self._compact_step = self._build_compact_step()
         self._wc_pending = 0.0
         self._batches_done = 0
-        self._pending_pushes: list = []
+        self._pending_pushes: List = []
 
     def _init_embeddings(self) -> None:
         """No full local matrices: the input table is random-initialized
@@ -344,7 +465,6 @@ class PSWord2Vec(Word2Vec):
         not."""
         config = self.config
         vocab, dim = self.dictionary.size, config.embedding_size
-        self._dim = dim
         bound = 0.5 / dim
         self._in_table = create_matrix_table(
             vocab, dim, updater_type="default",
@@ -356,46 +476,10 @@ class PSWord2Vec(Word2Vec):
         self._num_workers = max(
             zoo.num_workers if self._num_workers_override is None
             else self._num_workers_override, 1)
+        self._step = self._build_ps_step()
 
-    # -- compact jitted step over pulled rows --
-    def _build_compact_step(self):
-        config = self.config
-
-        def input_vec(ein, in_args):
-            if config.cbow:
-                win_l, win_mask = in_args
-                vecs = ein[win_l] * win_mask[..., None]
-                denom = jnp.maximum(win_mask.sum(axis=1, keepdims=True),
-                                    1.0)
-                return vecs.sum(axis=1) / denom
-            (centers_l,) = in_args
-            return ein[centers_l]
-
-        if config.hs:
-            def loss_fn(ein, eout, in_args, out_args, pair_mask):
-                v = input_vec(ein, in_args)
-                points_l, codes = out_args
-                mask = (codes >= 0).astype(jnp.float32) * pair_mask[:, None]
-                u = eout[points_l]  # [B, L, D]
-                logits = jnp.clip(jnp.einsum("bd,bld->bl", v, u),
-                                  -_MAX_EXP, _MAX_EXP)
-                labels = 1.0 - codes.astype(jnp.float32)
-                return jnp.sum(_sigmoid_xent(logits, labels * mask) * mask)
-        else:
-            k = config.negative
-
-            def loss_fn(ein, eout, in_args, out_args, pair_mask):
-                v = input_vec(ein, in_args)
-                targets_l, negs_l = out_args
-                cols = jnp.concatenate([targets_l[:, None], negs_l], axis=1)
-                u = eout[cols]  # [B, 1+K, D]
-                logits = jnp.clip(jnp.einsum("bd,bkd->bk", v, u),
-                                  -_MAX_EXP, _MAX_EXP)
-                batch = v.shape[0]
-                labels = jnp.concatenate(
-                    [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
-                return jnp.sum(_sigmoid_xent(logits, labels)
-                               * pair_mask[:, None])
+    def _build_ps_step(self):
+        loss_fn = self._compact_loss()
 
         def step(ein, eout, lr, in_args, out_args, pair_mask):
             loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
@@ -406,87 +490,50 @@ class PSWord2Vec(Word2Vec):
 
     # -- phase 1: row-set preparation + async pull --
     def _prepare(self, batch) -> _Prep:
-        config = self.config
-        if isinstance(batch, CbowBatch):
-            win, targets = batch.window, batch.centers
-            real = win[win >= 0]
-            rows_in = np.unique(real).astype(np.int32) if real.size \
-                else np.zeros(1, np.int32)
-            win_l = np.clip(np.searchsorted(rows_in, np.maximum(win, 0)),
-                            0, rows_in.size - 1).astype(np.int32)
-            in_args = (win_l, (win >= 0).astype(np.float32))
-        else:
-            centers, targets = batch.centers, batch.contexts
-            rows_in = np.unique(centers).astype(np.int32)
-            in_args = (np.searchsorted(rows_in, centers).astype(np.int32),)
-
-        if config.hs:
-            points = self._points_host[targets]  # [B, L], -1 padded
-            real = points[points >= 0]
-            rows_out = np.unique(real).astype(np.int32) if real.size \
-                else np.zeros(1, np.int32)
-            points_l = np.clip(
-                np.searchsorted(rows_out, np.maximum(points, 0)),
-                0, rows_out.size - 1).astype(np.int32)
-            out_args = (points_l, self._codes_host[targets])
-        else:
-            k = config.negative
-            # Clip: a draw above cdf[-1] (float rounding) must not index
-            # one past the last word.
-            neg = np.minimum(
-                np.searchsorted(self._neg_cdf_host,
-                                self._rng.random((targets.size, k))),
-                self.dictionary.size - 1).astype(np.int32)
-            rows_out = np.unique(
-                np.concatenate([targets, neg.reshape(-1)])).astype(np.int32)
-            out_args = (np.searchsorted(rows_out, targets).astype(np.int32),
-                        np.searchsorted(rows_out, neg).astype(np.int32))
-
-        rows_in_p = _pad_rows(rows_in)
-        rows_out_p = _pad_rows(rows_out)
-        buf_in = np.empty((rows_in_p.size, self._dim), np.float32)
-        buf_out = np.empty((rows_out_p.size, self._dim), np.float32)
+        compact = batch if isinstance(batch, CompactBatch) \
+            else self.prepare(batch)
+        buf_in = np.empty((compact.rows_in_p.size, self._dim), np.float32)
+        buf_out = np.empty((compact.rows_out_p.size, self._dim),
+                           np.float32)
         return _Prep(
-            batch=batch, rows_in=rows_in, rows_out=rows_out,
-            in_args=in_args, out_args=out_args,
-            buf_in=buf_in, buf_out=buf_out,
-            mid_in=self._in_table.get_rows_async(rows_in_p, out=buf_in),
-            mid_out=self._out_table.get_rows_async(rows_out_p, out=buf_out))
+            compact=compact, buf_in=buf_in, buf_out=buf_out,
+            mid_in=self._in_table.get_rows_async(compact.rows_in_p,
+                                                 out=buf_in),
+            mid_out=self._out_table.get_rows_async(compact.rows_out_p,
+                                                   out=buf_out))
 
     # -- phase 2: wait the pull, dispatch the device step (async) --
     def _launch(self, prep: _Prep) -> _Launched:
+        compact = prep.compact
         self._in_table.wait(prep.mid_in)
         self._out_table.wait(prep.mid_out)
         old_in = jnp.asarray(prep.buf_in)
         old_out = jnp.asarray(prep.buf_out)
-        size = prep.batch.centers.shape[0]
-        pair_mask = _full_mask(size) if prep.batch.count == size \
-            else jnp.asarray((np.arange(size) < prep.batch.count)
-                             .astype(np.float32))
-        new_in, new_out, loss = self._compact_step(
+        new_in, new_out, loss = self._step(
             old_in, old_out, jnp.float32(self.learning_rate()),
-            tuple(jnp.asarray(a) for a in prep.in_args),
-            tuple(jnp.asarray(a) for a in prep.out_args), pair_mask)
+            tuple(jnp.asarray(a) for a in compact.in_args),
+            tuple(jnp.asarray(a) for a in compact.out_args),
+            self._pair_mask_for(compact.count, compact.size))
         return _Launched(prep=prep, new_in=new_in, new_out=new_out,
                          old_in=old_in, old_out=old_out, loss=loss)
 
     # -- phase 3: materialize deltas, push, account words --
     def _finish(self, launched: _Launched) -> float:
-        prep = launched.prep
+        compact = launched.prep.compact
         scale = 1.0 / self._num_workers
         delta_in = np.asarray((launched.new_in - launched.old_in) * scale)
         delta_out = np.asarray((launched.new_out - launched.old_out)
                                * scale)
         self._pending_pushes.append((self._in_table,
                                      self._in_table.add_rows_async(
-                                         prep.rows_in,
-                                         delta_in[:prep.rows_in.size])))
+                                         compact.rows_in,
+                                         delta_in[:compact.rows_in.size])))
         self._pending_pushes.append((self._out_table,
                                      self._out_table.add_rows_async(
-                                         prep.rows_out,
-                                         delta_out[:prep.rows_out.size])))
-        self._account_words(prep.batch.words)
-        return float(launched.loss) / max(prep.batch.count, 1)
+                                         compact.rows_out,
+                                         delta_out[:compact.rows_out.size])))
+        self._account_words(compact.words)
+        return float(launched.loss) / max(compact.count, 1)
 
     def _drain_pushes(self) -> None:
         """Wait every outstanding Add ack: a barrier alone orders only
@@ -517,6 +564,12 @@ class PSWord2Vec(Word2Vec):
             self.trained_words = max(self.trained_words, int(global_words))
 
     # -- public API --
+    def prepared(self, batches):
+        """Generator adapter: raw batches -> CompactBatch (run inside a
+        BlockLoader so host row preparation overlaps device steps)."""
+        for batch in batches:
+            yield self.prepare(batch)
+
     def train_batch(self, batch) -> float:
         loss = self._finish(self._launch(self._prepare(batch)))
         self._drain_pushes()
@@ -536,13 +589,13 @@ class PSWord2Vec(Word2Vec):
             prep = self._prepare(batch)  # async pull in flight
             if launched is not None:
                 loss_sum += self._finish(launched) \
-                    * max(launched.prep.batch.count, 1)
-                pairs += launched.prep.batch.count
+                    * max(launched.prep.compact.count, 1)
+                pairs += launched.prep.compact.count
             launched = self._launch(prep)
         if launched is not None:
             loss_sum += self._finish(launched) \
-                * max(launched.prep.batch.count, 1)
-            pairs += launched.prep.batch.count
+                * max(launched.prep.compact.count, 1)
+            pairs += launched.prep.compact.count
         # Every push acked, trailing word count published, then the
         # barrier: a peer's post-barrier read sees all of our updates.
         self._drain_pushes()

@@ -214,7 +214,13 @@ class TcpNet(NetInterface):
                 delay = min(delay * 2, 0.5)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
-        self._out[dst] = sock
+        with self._lifecycle:
+            if self._closed:
+                # finalize() ran while we were connecting; don't leak the
+                # socket or let a send slip out after teardown.
+                sock.close()
+                raise RuntimeError("TcpNet finalized")
+            self._out[dst] = sock
         return sock
 
     # -- inbound mesh --

@@ -42,10 +42,31 @@ from ..core.message import MsgType
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, GetOption, UpdateEngine, create_rule
 from ..updater.engine import pad_ids
+from ..util.configure import define_bool, get_flag
 from ..util.log import CHECK
+from ..util.quantization import SparseFilter
 from .table_interface import ServerTable, WorkerTable
 
+define_bool("sparse_compress", True,
+            "run sparse-matrix wire traffic through SparseFilter "
+            "(ref: sparse_matrix_table.cpp:148-153)")
+
 _ALL_KEY = np.array([-1], dtype=np.int32)
+
+
+def _compress_values(values: np.ndarray) -> List[Blob]:
+    """[values] -> [values(maybe (index,value) pairs), size_record]
+    (ref: quantization_util.h:37-137)."""
+    comp, sizes = SparseFilter().filter_in([values.reshape(-1)])
+    return [Blob(comp[0]), Blob(sizes)]
+
+
+def _decompress_values(values_blob: Blob, sizes_blob: Blob,
+                       dtype) -> np.ndarray:
+    sizes = sizes_blob.as_array(np.int64)
+    raw = values_blob.as_array(
+        np.float64 if sizes[0] != -1 else dtype)
+    return SparseFilter().filter_out([raw], sizes, dtype=dtype)[0]
 
 
 def row_offsets(num_row: int, num_servers: int) -> List[int]:
@@ -90,6 +111,10 @@ class MatrixWorker(WorkerTable):
         self.num_col = int(num_col)
         self.dtype = np.dtype(dtype)
         self.is_sparse = bool(is_sparse)
+        # Wire compression for sparse traffic, both directions, as the
+        # reference does unconditionally (sparse_matrix_table.cpp:148-153);
+        # here behind a flag read at table-construction time.
+        self._compress = self.is_sparse and bool(get_flag("sparse_compress"))
         self._offsets = row_offsets(self.num_row, self._zoo.num_servers)
         self._num_server = len(self._offsets) - 1  # actual servers used
         self._row_length = max(self.num_row // self._num_server, 1)
@@ -184,13 +209,19 @@ class MatrixWorker(WorkerTable):
         out: Dict[int, List[Blob]] = {}
         if keys.size == 1 and keys[0] == -1:
             is_add = msg_type == MsgType.Request_Add
+            compress = is_add and self._compress
             values = blobs[1].typed(self.dtype) if is_add else None
+            if compress and is_device_array(values):
+                values = np.asarray(values)  # host bytes at the wire
             for sid in range(self._num_server):
                 shard = [blobs[0]]
                 if values is not None:
                     lo, hi = self._offsets[sid], self._offsets[sid + 1]
-                    shard.append(Blob(
-                        values[lo * self.num_col:hi * self.num_col]))
+                    chunk = values[lo * self.num_col:hi * self.num_col]
+                    if compress:
+                        shard.extend(_compress_values(np.asarray(chunk)))
+                    else:
+                        shard.append(Blob(chunk))
                     if len(blobs) == 3:
                         shard.append(blobs[2])
                 elif len(blobs) == 2:  # sparse Get: GetOption rides along
@@ -208,7 +239,11 @@ class MatrixWorker(WorkerTable):
             mask = dest == sid
             shard = [Blob(np.ascontiguousarray(keys[mask]).view(np.uint8))]
             if values is not None:
-                shard.append(Blob(np.ascontiguousarray(values[mask])))
+                chunk = np.ascontiguousarray(values[mask])
+                if self._compress:
+                    shard.extend(_compress_values(chunk))
+                else:
+                    shard.append(Blob(chunk))
                 if len(blobs) == 3:
                     shard.append(blobs[2])
             elif len(blobs) == 2:  # sparse GetOption
@@ -246,8 +281,13 @@ class MatrixWorker(WorkerTable):
             values = reply_blobs[1].as_array(self.dtype)
             self._dest[lo:hi] = values.reshape(hi - lo, self.num_col)
             return
-        values = reply_blobs[1].as_array(self.dtype).reshape(
-            keys.size, self.num_col)
+        if self._compress and len(reply_blobs) == 3:
+            values = _decompress_values(
+                reply_blobs[1], reply_blobs[2],
+                self.dtype).reshape(keys.size, self.num_col)
+        else:
+            values = reply_blobs[1].as_array(self.dtype).reshape(
+                keys.size, self.num_col)
         if self._dest_rows is None:
             # Sparse whole-table get: dirty rows land at their global index.
             self._dest[keys] = values
@@ -266,6 +306,7 @@ class MatrixServer(ServerTable):
         self.dtype = np.dtype(dtype)
         self.num_col = int(num_col)
         self.is_sparse = bool(is_sparse)
+        self._compress = self.is_sparse and bool(get_flag("sparse_compress"))
         offsets = row_offsets(int(num_row), self._zoo.num_servers)
         sid = self._zoo.server_id
         self.server_id = sid
@@ -303,11 +344,22 @@ class MatrixServer(ServerTable):
 
     # -- Add (ref: matrix_table.cpp:386-418, sparse_matrix_table.cpp:200-223)
     def process_add(self, blobs: List[Blob]) -> None:
-        CHECK(len(blobs) in (2, 3), "add needs [keys, values(, option)]")
-        option = AddOption.from_blob(blobs[2]) if len(blobs) == 3 else None
         keys = blobs[0].as_array(np.int32)
-        if keys.size == 1 and keys[0] == -1:
+        if self._compress:
+            # Compressed wire layout: [keys, values, size_record(, option)]
+            # (ref decompression on receive: sparse_matrix_table.cpp:
+            # 148-153).
+            CHECK(len(blobs) in (3, 4), "compressed add needs "
+                  "[keys, values, sizes(, option)]")
+            option = AddOption.from_blob(blobs[3]) \
+                if len(blobs) == 4 else None
+            delta = _decompress_values(blobs[1], blobs[2], self.dtype)
+        else:
+            CHECK(len(blobs) in (2, 3), "add needs [keys, values(, option)]")
+            option = AddOption.from_blob(blobs[2]) \
+                if len(blobs) == 3 else None
             delta = blobs[1].typed(self.dtype)
+        if keys.size == 1 and keys[0] == -1:
             CHECK(int(np.prod(delta.shape)) == self.my_rows * self.num_col,
                   "whole-table add size mismatch")
             self._data = self._engine.apply_dense(
@@ -316,7 +368,7 @@ class MatrixServer(ServerTable):
                 self._mark_dirty(slice(None), option)
             return
         local_rows = keys - self.row_offset
-        delta = blobs[1].as_array(self.dtype).reshape(keys.size, self.num_col)
+        delta = np.asarray(delta).reshape(keys.size, self.num_col)
         self._data = self._engine.apply_rows(self._data, local_rows, delta,
                                              option)
         if self._up_to_date is not None:
@@ -352,7 +404,14 @@ class MatrixServer(ServerTable):
             opt = GetOption.from_blob(blobs[1])
             if 0 <= opt.worker_id < self._up_to_date.shape[0]:
                 self._up_to_date[opt.worker_id, local_rows] = True
-        return [blobs[0], Blob(values)]
+        return [blobs[0]] + self._reply_values(values)
+
+    def _reply_values(self, values) -> List[Blob]:
+        """Get replies run through the wire filter for sparse tables
+        (ref: sparse_matrix_table.cpp:261-308)."""
+        if self._compress:
+            return _compress_values(np.asarray(values))
+        return [Blob(values)]
 
     def _sparse_get_all(self, opt: GetOption) -> List[Blob]:
         """Return only this worker's dirty rows
@@ -363,7 +422,7 @@ class MatrixServer(ServerTable):
         self._up_to_date[wid, dirty] = True
         padded_rows = pad_ids(dirty, self._data.shape[0])
         values = self._gather(self._data, padded_rows)[:dirty.size]
-        return [Blob(dirty + self.row_offset), Blob(values)]
+        return [Blob(dirty + self.row_offset)] + self._reply_values(values)
 
     @functools.cached_property
     def _gather(self):

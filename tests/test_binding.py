@@ -126,9 +126,17 @@ class TestParamManagers:
                                    np.full(3, 2.0))
 
 
-@pytest.mark.skipif(not _build_lib(),
-                    reason="libmultiverso.so failed to build "
-                           "(make -C native)")
+@pytest.fixture(scope="module")
+def shim_lib():
+    """Build the .so lazily at test run time (not collection time — a
+    skipif condition would compile native code even for --collect-only
+    or deselected runs)."""
+    if not _build_lib():
+        pytest.skip("libmultiverso.so failed to build (make -C native)")
+    return LIB_PATH
+
+
+@pytest.mark.usefixtures("shim_lib")
 class TestCApiShim:
     def test_full_roundtrip_in_subprocess(self):
         # Load the shared library the way the reference binding does and
@@ -166,3 +174,20 @@ print("C_ABI_OK")
             [sys.executable, "-c", code], capture_output=True, text=True,
             timeout=240, env=dict(os.environ, PYTHONPATH=REPO))
         assert "C_ABI_OK" in result.stdout, result.stderr[-800:]
+
+    def test_lua_binding(self):
+        # The LuaJIT FFI binding drives the same .so (ref: binding/lua/).
+        # The test image ships no Lua runtime; the binding is validated
+        # here when one exists and in CI images that carry luajit.
+        import shutil
+        lua = next((exe for exe in ("luajit", "lua5.1", "lua")
+                    if shutil.which(exe)), None)
+        if lua is None:
+            pytest.skip("no Lua runtime in this image")
+        result = subprocess.run(
+            [lua, "test.lua"], cwd=os.path.join(REPO, "binding", "lua"),
+            capture_output=True, text=True, timeout=240,
+            env=dict(os.environ, PYTHONPATH=REPO,
+                     MULTIVERSO_LIB=LIB_PATH))
+        assert "LUA_BINDING_OK" in result.stdout, \
+            result.stdout[-400:] + result.stderr[-800:]

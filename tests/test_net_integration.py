@@ -37,7 +37,7 @@ rank = int(os.environ["MV_RANK"])
 """
 
 
-def run_cluster(bodies, machine_file=None, timeout=240):
+def run_cluster(bodies, timeout=240):
     """Spawn one python per body; body i runs with MV_RANK=i. Returns
     the stdout of each after asserting all exited cleanly."""
     procs = []
@@ -118,7 +118,7 @@ net.recv(timeout=10)  # drain until peer closes (returns None)
 net.finalize()
 print("ECHO_OK")
 """
-    outs = run_cluster([body0, body1], machine_file=mf)
+    outs = run_cluster([body0, body1])
     assert "PINGPONG_OK" in outs[0] and "ECHO_OK" in outs[1]
 
 
