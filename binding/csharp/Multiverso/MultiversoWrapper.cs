@@ -1,0 +1,167 @@
+// Static facade mirroring the reference's MultiversoCLR surface
+// (ref: binding/C#/MultiversoCLR/MultiversoCLR.h:11-45,
+//  binding/C#/MultiversoCLR/MultiversoCLR.cpp:23-115): Init/Shutdown,
+// CreateTable(s), Rank/Size/Barrier, and Get/Add by whole table or row.
+//
+// Differences from the CLR original, by design:
+//  - float only: the c_api ABI is float-only (ref: include/multiverso/
+//    c_api.h:28-54), so the `generic <class Type>` surface collapses to
+//    float[] overloads.
+//  - NetBind/NetConnect: deployment bootstrap is driven through MV_Init
+//    argv flags (-machine_file/-port, the TCP transport's bind+connect
+//    path) rather than separate entry points.
+
+using System;
+using System.Collections.Generic;
+
+namespace Multiverso
+{
+    public static class MultiversoWrapper
+    {
+        private static readonly List<ITableHandle> Tables = new List<ITableHandle>();
+
+        private interface ITableHandle
+        {
+            void Get(float[] value);
+            void Get(int rowId, float[] value);
+            void Add(float[] update, bool sync);
+            void Add(int rowId, float[] update, bool sync);
+        }
+
+        /// <summary>Init with table slots; args become -key=value argv
+        /// entries (e.g. "-sync=true", "-machine_file=hosts.txt").</summary>
+        public static void Init(int numTables, bool sync, params string[] extraArgs)
+        {
+            var argv = new List<string> { "csharp" };
+            if (sync) argv.Add("-sync=true");
+            argv.AddRange(extraArgs);
+            int argc = argv.Count;
+            NativeMethods.MV_Init(ref argc, argv.ToArray());
+            Tables.Clear();
+            for (int i = 0; i < numTables; ++i) Tables.Add(null);
+        }
+
+        public static void Shutdown()
+        {
+            Tables.Clear();
+            NativeMethods.MV_ShutDown();
+        }
+
+        public static int Rank() { return NativeMethods.MV_WorkerId(); }
+
+        public static int Size() { return NativeMethods.MV_NumWorkers(); }
+
+        public static int ServerId() { return NativeMethods.MV_ServerId(); }
+
+        public static void Barrier() { NativeMethods.MV_Barrier(); }
+
+        public static void CreateTables(int[] rows, int[] cols)
+        {
+            for (int i = 0; i < rows.Length; ++i) CreateTable(i, rows[i], cols[i]);
+        }
+
+        /// <summary>rows == 1 creates an Array table of `cols` elements;
+        /// otherwise a rows×cols Matrix table — the same mapping the CLR
+        /// wrapper's eleType/shape dispatch performed.</summary>
+        public static void CreateTable(int tableId, int rows, int cols)
+        {
+            while (Tables.Count <= tableId) Tables.Add(null);
+            Tables[tableId] = rows == 1
+                ? (ITableHandle)new ArrayHandle(cols)
+                : new MatrixHandle(rows, cols);
+        }
+
+        public static void Get(int tableId, float[] value)
+        {
+            Tables[tableId].Get(value);
+        }
+
+        public static void Get(int tableId, int rowId, float[] value)
+        {
+            Tables[tableId].Get(rowId, value);
+        }
+
+        public static void Add(int tableId, float[] update)
+        {
+            Tables[tableId].Add(update, sync: true);
+        }
+
+        public static void Add(int tableId, int rowId, float[] update)
+        {
+            Tables[tableId].Add(rowId, update, sync: true);
+        }
+
+        public static void AddAsync(int tableId, float[] update)
+        {
+            Tables[tableId].Add(update, sync: false);
+        }
+
+        private sealed class ArrayHandle : ITableHandle
+        {
+            private readonly IntPtr handle;
+
+            internal ArrayHandle(int size)
+            {
+                NativeMethods.MV_NewArrayTable(size, out handle);
+            }
+
+            public void Get(float[] value)
+            {
+                NativeMethods.MV_GetArrayTable(handle, value, value.Length);
+            }
+
+            public void Get(int rowId, float[] value)
+            {
+                throw new InvalidOperationException("array tables have no rows");
+            }
+
+            public void Add(float[] update, bool sync)
+            {
+                if (sync) NativeMethods.MV_AddArrayTable(handle, update, update.Length);
+                else NativeMethods.MV_AddAsyncArrayTable(handle, update, update.Length);
+            }
+
+            public void Add(int rowId, float[] update, bool sync)
+            {
+                throw new InvalidOperationException("array tables have no rows");
+            }
+        }
+
+        private sealed class MatrixHandle : ITableHandle
+        {
+            private readonly IntPtr handle;
+
+            internal MatrixHandle(int rows, int cols)
+            {
+                NativeMethods.MV_NewMatrixTable(rows, cols, out handle);
+            }
+
+            public void Get(float[] value)
+            {
+                NativeMethods.MV_GetMatrixTableAll(handle, value, value.Length);
+            }
+
+            public void Get(int rowId, float[] value)
+            {
+                NativeMethods.MV_GetMatrixTableByRows(
+                    handle, value, value.Length, new[] { rowId }, 1);
+            }
+
+            public void Add(float[] update, bool sync)
+            {
+                if (sync) NativeMethods.MV_AddMatrixTableAll(handle, update, update.Length);
+                else NativeMethods.MV_AddAsyncMatrixTableAll(handle, update, update.Length);
+            }
+
+            public void Add(int rowId, float[] update, bool sync)
+            {
+                if (sync)
+                    NativeMethods.MV_AddMatrixTableByRows(
+                        handle, update, update.Length, new[] { rowId }, 1);
+                else
+                    NativeMethods.MV_AddAsyncMatrixTableByRows(
+                        handle, update, update.Length, new[] { rowId }, 1);
+            }
+        }
+    }
+}

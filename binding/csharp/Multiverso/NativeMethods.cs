@@ -1,0 +1,79 @@
+// P/Invoke declarations over the libmultiverso c_api ABI.
+//
+// The reference ships a Windows-only C++/CLI wrapper
+// (ref: binding/C#/MultiversoCLR/MultiversoCLR.h:11-45) that links the C++
+// API directly. This binding is a portable re-design: pure C# DllImport over
+// the flat C ABI (ref: include/multiverso/c_api.h:14-54) — the same ABI the
+// Python (ctypes) and Lua (LuaJIT FFI) bindings load — so it runs on .NET
+// (Core) / Mono on Linux against the TPU-native libmultiverso.so.
+
+using System;
+using System.Runtime.InteropServices;
+
+namespace Multiverso
+{
+    internal static class NativeMethods
+    {
+        // Resolved via the standard loader search path; set LD_LIBRARY_PATH
+        // to native/build/ or use NativeLibrary.SetDllImportResolver.
+        internal const string LibName = "multiverso";
+
+        [DllImport(LibName, EntryPoint = "MV_Init")]
+        internal static extern void MV_Init(ref int argc, string[] argv);
+
+        [DllImport(LibName, EntryPoint = "MV_ShutDown")]
+        internal static extern void MV_ShutDown();
+
+        [DllImport(LibName, EntryPoint = "MV_Barrier")]
+        internal static extern void MV_Barrier();
+
+        [DllImport(LibName, EntryPoint = "MV_NumWorkers")]
+        internal static extern int MV_NumWorkers();
+
+        [DllImport(LibName, EntryPoint = "MV_WorkerId")]
+        internal static extern int MV_WorkerId();
+
+        [DllImport(LibName, EntryPoint = "MV_ServerId")]
+        internal static extern int MV_ServerId();
+
+        // -- Array table (float only, as in the reference c_api) --
+
+        [DllImport(LibName, EntryPoint = "MV_NewArrayTable")]
+        internal static extern void MV_NewArrayTable(int size, out IntPtr handler);
+
+        [DllImport(LibName, EntryPoint = "MV_GetArrayTable")]
+        internal static extern void MV_GetArrayTable(IntPtr handler, float[] data, int size);
+
+        [DllImport(LibName, EntryPoint = "MV_AddArrayTable")]
+        internal static extern void MV_AddArrayTable(IntPtr handler, float[] data, int size);
+
+        [DllImport(LibName, EntryPoint = "MV_AddAsyncArrayTable")]
+        internal static extern void MV_AddAsyncArrayTable(IntPtr handler, float[] data, int size);
+
+        // -- Matrix table --
+
+        [DllImport(LibName, EntryPoint = "MV_NewMatrixTable")]
+        internal static extern void MV_NewMatrixTable(int numRow, int numCol, out IntPtr handler);
+
+        [DllImport(LibName, EntryPoint = "MV_GetMatrixTableAll")]
+        internal static extern void MV_GetMatrixTableAll(IntPtr handler, float[] data, int size);
+
+        [DllImport(LibName, EntryPoint = "MV_AddMatrixTableAll")]
+        internal static extern void MV_AddMatrixTableAll(IntPtr handler, float[] data, int size);
+
+        [DllImport(LibName, EntryPoint = "MV_AddAsyncMatrixTableAll")]
+        internal static extern void MV_AddAsyncMatrixTableAll(IntPtr handler, float[] data, int size);
+
+        [DllImport(LibName, EntryPoint = "MV_GetMatrixTableByRows")]
+        internal static extern void MV_GetMatrixTableByRows(
+            IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+
+        [DllImport(LibName, EntryPoint = "MV_AddMatrixTableByRows")]
+        internal static extern void MV_AddMatrixTableByRows(
+            IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+
+        [DllImport(LibName, EntryPoint = "MV_AddAsyncMatrixTableByRows")]
+        internal static extern void MV_AddAsyncMatrixTableByRows(
+            IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+    }
+}

@@ -1,0 +1,36 @@
+// Multi-worker counter demo — the C# analogue of the Python binding's
+// test (ref: binding/python/multiverso/tests/test_multiverso.py:18-60):
+// every worker adds i to slot i, barriers, and reads back i * num_workers.
+
+using System;
+using Multiverso;
+
+namespace MultiversoExamples
+{
+    public static class Counter
+    {
+        public static void Main(string[] args)
+        {
+            const int size = 8;
+            MultiversoWrapper.Init(numTables: 1, sync: true, extraArgs: args);
+            MultiversoWrapper.CreateTable(0, rows: 1, cols: size);
+            MultiversoWrapper.Barrier();
+
+            var delta = new float[size];
+            for (int i = 0; i < size; ++i) delta[i] = i;
+            MultiversoWrapper.Add(0, delta);
+            MultiversoWrapper.Barrier();
+
+            var value = new float[size];
+            MultiversoWrapper.Get(0, value);
+            int workers = MultiversoWrapper.Size();
+            for (int i = 0; i < size; ++i)
+            {
+                if (Math.Abs(value[i] - i * workers) > 1e-5)
+                    throw new Exception($"slot {i}: got {value[i]}, want {i * workers}");
+            }
+            Console.WriteLine($"counter OK on rank {MultiversoWrapper.Rank()}/{workers}");
+            MultiversoWrapper.Shutdown();
+        }
+    }
+}

@@ -175,6 +175,35 @@ print("C_ABI_OK")
             timeout=240, env=dict(os.environ, PYTHONPATH=REPO))
         assert "C_ABI_OK" in result.stdout, result.stderr[-800:]
 
+    def test_csharp_binding_abi(self):
+        # The C# binding is pure P/Invoke source (ref: the CLR wrapper's
+        # surface, binding/C#/MultiversoCLR/MultiversoCLR.h:11-45). No
+        # .NET SDK ships in this image, so validate structurally: every
+        # DllImport EntryPoint must exist in the built .so, and the
+        # wrapper facade must exercise the full native surface.
+        import re
+        cs_dir = os.path.join(REPO, "binding", "csharp", "Multiverso")
+        with open(os.path.join(cs_dir, "NativeMethods.cs")) as f:
+            native_src = f.read()
+        entry_points = re.findall(r'EntryPoint = "(\w+)"', native_src)
+        assert len(entry_points) >= 16, entry_points
+        lib = ctypes.CDLL(LIB_PATH)
+        for symbol in entry_points:
+            assert getattr(lib, symbol, None) is not None, \
+                f"{symbol} declared in NativeMethods.cs but not exported"
+        with open(os.path.join(cs_dir, "MultiversoWrapper.cs")) as f:
+            wrapper_src = f.read()
+        used = set(re.findall(r"NativeMethods\.(\w+)", wrapper_src))
+        assert used == set(entry_points), \
+            f"wrapper does not cover the ABI: missing {set(entry_points) - used}"
+        # If an SDK happens to be present, actually compile the project.
+        import shutil
+        if shutil.which("dotnet"):
+            result = subprocess.run(
+                ["dotnet", "build", "-nologo"], cwd=cs_dir,
+                capture_output=True, text=True, timeout=300)
+            assert result.returncode == 0, result.stdout[-800:]
+
     def test_lua_binding(self):
         # The LuaJIT FFI binding drives the same .so (ref: binding/lua/).
         # The test image ships no Lua runtime; the binding is validated
