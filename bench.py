@@ -82,14 +82,20 @@ def _build(corpus: str):
     return dictionary, tokenized
 
 
-def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS) -> dict:
+def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
+              schedule_epochs: int = None) -> dict:
+    """Train ``epochs`` epochs. ``schedule_epochs`` (default = epochs)
+    sets the lr-decay horizon — the CPU parity baseline trains ONE epoch
+    under the SAME schedule as the full run, so epoch-0 losses are
+    comparable."""
     from multiverso_tpu.models.wordembedding import (BlockLoader,
                                                      Word2Vec,
                                                      Word2VecConfig,
                                                      iter_pair_batches)
     dictionary, tokenized = prebuilt if prebuilt else _build(corpus)
     config = Word2VecConfig(embedding_size=DIM, window=5, negative=NEG,
-                            epochs=epochs, batch_size=BATCH, sample=1e-3)
+                            epochs=schedule_epochs or epochs,
+                            batch_size=BATCH, sample=1e-3)
     model = Word2Vec(config, dictionary)
     warm = next(iter(iter_pair_batches(dictionary, tokenized,
                                        batch_size=BATCH, window=5,
@@ -122,7 +128,8 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
     """Same workload through the parameter-server path (row-sparse
     pulls, compact step, delta pushes, pipelined)."""
     import multiverso_tpu as mv
-    from multiverso_tpu.models.wordembedding import (PSWord2Vec,
+    from multiverso_tpu.models.wordembedding import (BlockLoader,
+                                                     PSWord2Vec,
                                                      Word2VecConfig,
                                                      iter_pair_batches)
     dictionary, tokenized = prebuilt if prebuilt else _build(corpus)
@@ -132,18 +139,23 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
                             use_ps=True)
     model = PSWord2Vec(config, dictionary)
 
-    def capped(seed):
+    def capped(seed, cap=PS_MAX_BATCHES):
         for i, batch in enumerate(iter_pair_batches(
                 dictionary, tokenized, batch_size=BATCH, window=5,
                 subsample=1e-3, seed=seed)):
-            if i >= PS_MAX_BATCHES:
+            if i >= cap:
                 return
             yield batch
 
-    model.train_batch(next(capped(99)))  # compile + first pull
+    # Warm OUTSIDE the timed region: 3 batches cover every compile the
+    # steady loop hits (row gathers per bucket, the fused step, and the
+    # scatter engine's both post-donation input layouts).
+    for warm_batch in capped(99, cap=3):
+        model.train_batch(warm_batch)
     warm_words = model.trained_words
     start = time.perf_counter()
-    loss_sum, pairs = model.train_batches(capped(0))
+    loss_sum, pairs = model.train_batches(
+        BlockLoader(model.prepared(capped(0))))
     elapsed = time.perf_counter() - start
     words = model.trained_words - warm_words
     separation = topic_separation(model.embeddings, dictionary)
@@ -187,7 +199,8 @@ def cpu_baseline(corpus: str) -> dict:
         f"bench.DIM={DIM}; bench.NEG={NEG}\n"
         # One epoch: words/s is a rate and loss parity compares the
         # fixed-seed FIRST epoch; 3 CPU epochs would triple bench time.
-        f"r = bench.run_local({corpus!r}, epochs=1)\n"
+        f"r = bench.run_local({corpus!r}, epochs=1,"
+        f" schedule_epochs={EPOCHS})\n"
         "print('RES', json.dumps({'wps': r['wps'],"
         " 'epoch_losses': r['epoch_losses']}))\n"
     )
@@ -236,35 +249,55 @@ def matrix_bandwidth() -> dict:
 
     num_row, num_col, iters = 1_000_000, 50, 10
     nbytes = num_row * num_col * 4
+    import jax
+
     mv.init([])
     table = mv.create_matrix_table(num_row, num_col)
     delta = jnp.ones((num_row, num_col), jnp.float32)
-    _ = float(delta[0, 0])
+    jax.block_until_ready(delta)
     table.add(delta)
-    out = table.get_device()
-    _ = float(out[0, 0])
+    jax.block_until_ready(table.get_device())  # compile + settle
     start = time.perf_counter()
     ids = [table.add_async(delta) for _ in range(iters)]
     for msg_id in ids:
         table.wait(msg_id)
-    out = table.get_device()
-    _ = float(out[0, 0])
+    jax.block_until_ready(table.get_device())
     add_gbps = nbytes / ((time.perf_counter() - start) / (iters + 1)) / 1e9
     start = time.perf_counter()
-    for _ in range(iters):
-        out = table.get_device()
-    _ = float(out[0, 0])
+    outs = [table.get_device() for _ in range(iters)]
+    jax.block_until_ready(outs[-1])
     get_gbps = nbytes / ((time.perf_counter() - start) / iters) / 1e9
+    del outs
+
+    # Tunnel characterization: the dirty-row sparse Get fills a HOST
+    # buffer (reference API semantics), so on a tunneled device it is
+    # capped by device->host bandwidth, not by the table stack. Measure
+    # and report both directions so the sparse number is interpretable.
+    probe = np.ones(4 << 20, np.float32)  # 16 MB
+    jax.block_until_ready(jnp.asarray(probe))
+    t0 = time.perf_counter()
+    dev_probe = jnp.asarray(probe)
+    jax.block_until_ready(dev_probe)
+    up_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+    fresh = jax.block_until_ready(dev_probe * 2.0)
+    t0 = time.perf_counter()
+    np.asarray(fresh)
+    down_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
 
     # Sparse dirty-row path (ref: test_matrix_perf.cpp sparse variants):
-    # dirty 10% of rows per round, dirty-only whole-table get.
+    # dirty rows per round, dirty-only whole-table get.
     sparse = mv.create_matrix_table(num_row, num_col, is_sparse=True)
     buf = np.zeros((num_row, num_col), np.float32)
     sparse.get(out=buf)  # initial full sync marks everything clean
-    dirty_n = num_row // 10
+    dirty_n = num_row // 50
     rows = np.arange(dirty_n, dtype=np.int32) * 10
     row_delta = np.ones((dirty_n, num_col), np.float32)
     opt = AddOption(worker_id=1)  # dirties the rows for worker 0
+    # One untimed roundtrip: compiles the dirty-row gather/scatter for
+    # this row-count bucket (compiling inside the timed loop would
+    # swamp 3 iterations).
+    sparse.add_rows(rows, row_delta, option=opt)
+    sparse.get(out=buf)
     start = time.perf_counter()
     sparse_iters = 3
     for _ in range(sparse_iters):
@@ -276,23 +309,40 @@ def matrix_bandwidth() -> dict:
     mv.shutdown()
     return {"add_gbps": round(add_gbps, 3),
             "get_gbps": round(get_gbps, 3),
-            "sparse_dirty_roundtrip_gbps": round(sparse_gbps, 3)}
+            "sparse_dirty_roundtrip_gbps": round(sparse_gbps, 3),
+            "tunnel_upload_mbps": round(up_mbps, 1),
+            "tunnel_download_mbps": round(down_mbps, 1)}
+
+
+def _phase(name: str, fn, *args, **kw):
+    """Run one bench phase with stderr progress + timing (stdout carries
+    only the final JSON line)."""
+    print(f"[bench] {name}...", file=sys.stderr, flush=True)
+    start = time.perf_counter()
+    out = fn(*args, **kw)
+    dt = time.perf_counter() - start
+    _phase.seconds[name] = round(dt, 1)
+    print(f"[bench] {name} done in {dt:.1f}s", file=sys.stderr, flush=True)
+    return out
+
+
+_phase.seconds = {}
 
 
 def main() -> None:
     tmp = tempfile.mkdtemp()
     corpus = os.path.join(tmp, "corpus.txt")
-    write_corpus(corpus)
-    prebuilt = _build(corpus)
-    local = run_local(corpus, prebuilt)
-    ps = run_ps(corpus, prebuilt)
+    _phase("write_corpus", write_corpus, corpus)
+    prebuilt = _phase("build_dictionary", _build, corpus)
+    local = _phase("local_train", run_local, corpus, prebuilt)
+    ps = _phase("ps_train", run_ps, corpus, prebuilt)
     try:
-        cpu = cpu_baseline(corpus)
+        cpu = _phase("cpu_baseline", cpu_baseline, corpus)
     except Exception as exc:  # noqa: BLE001 - report without a baseline
         cpu = None
         baseline_err = str(exc)[:200]
     util = utilization(local["pairs_per_sec"])
-    matrix = matrix_bandwidth()
+    matrix = _phase("matrix_bandwidth", matrix_bandwidth)
 
     parity = None
     if cpu:
@@ -320,6 +370,7 @@ def main() -> None:
             "cpu_backend_words_per_sec": round(cpu["wps"], 0) if cpu
             else None,
             "matrix_table_bandwidth": matrix,
+            "phase_seconds": dict(_phase.seconds),
             "setup": {"vocab_raw": VOCAB, "sentences": SENTENCES,
                       "epochs": EPOCHS, "batch": BATCH, "dim": DIM,
                       "negative": NEG,

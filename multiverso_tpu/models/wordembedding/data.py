@@ -130,10 +130,16 @@ def iter_sentences(dictionary: Dictionary, corpus,
 def iter_pair_batches(dictionary: Dictionary, corpus_path,
                       batch_size: int = 4096, window: int = 5,
                       subsample: float = 1e-3, cbow: bool = False,
-                      seed: int = 1) -> Iterator:
+                      seed: int = 1,
+                      chunk_words: int = 16384) -> Iterator:
     """Walk sentences emitting fixed-shape batches; the per-center window
     size shrinks uniformly in [1, window] (the word2vec trick,
-    ref: wordembedding.cpp Train window sampling)."""
+    ref: wordembedding.cpp Train window sampling).
+
+    Sentences are expanded to pairs in multi-sentence CHUNKS
+    (``chunk_sentence_pairs``): per-sentence numpy calls are the loader
+    bottleneck at scale — one vectorized call per ~16K words instead of
+    one per ~40-word sentence keeps the loader ahead of the device."""
     rng = np.random.default_rng(seed + 7)
     if cbow:
         yield from _iter_cbow(dictionary, corpus_path, batch_size, window,
@@ -144,15 +150,27 @@ def iter_pair_batches(dictionary: Dictionary, corpus_path,
     # spread over its pairs; sums are exact across batch boundaries).
     pending: List[np.ndarray] = []  # [3, k]: center, context, word-frac
     pending_count = 0
-    for ids, raw_words in iter_sentences(dictionary, corpus_path,
-                                         subsample, seed):
-        pairs = sentence_pairs(ids, window, rng)
-        if pairs.shape[1] == 0:
-            continue
-        frac = np.full(pairs.shape[1], raw_words / pairs.shape[1])
-        pending.append(np.concatenate([pairs.astype(np.float64),
-                                       frac[None, :]]))
-        pending_count += pairs.shape[1]
+    chunk: List[np.ndarray] = []
+    chunk_raw: List[int] = []
+    chunk_n = 0
+
+    def flush_chunk():
+        nonlocal pending, pending_count, chunk, chunk_raw, chunk_n
+        if not chunk:
+            return
+        pairs, sent_of_pair = chunk_sentence_pairs(chunk, window, rng)
+        if pairs.shape[1]:
+            # Per-sentence raw words spread over that sentence's pairs.
+            per_sent = np.bincount(sent_of_pair, minlength=len(chunk))
+            raw = np.asarray(chunk_raw, np.float64)
+            frac = (raw / np.maximum(per_sent, 1))[sent_of_pair]
+            pending.append(np.concatenate([pairs.astype(np.float64),
+                                           frac[None, :]]))
+            pending_count += pairs.shape[1]
+        chunk, chunk_raw, chunk_n = [], [], 0
+
+    def drain_full_batches():
+        nonlocal pending, pending_count
         while pending_count >= batch_size:
             flat = np.concatenate(pending, axis=1)
             yield PairBatch(flat[0, :batch_size].astype(np.int32),
@@ -162,6 +180,18 @@ def iter_pair_batches(dictionary: Dictionary, corpus_path,
             rest = flat[:, batch_size:]
             pending = [rest] if rest.shape[1] else []
             pending_count = rest.shape[1]
+
+    for ids, raw_words in iter_sentences(dictionary, corpus_path,
+                                         subsample, seed):
+        chunk.append(ids)
+        chunk_raw.append(raw_words)
+        chunk_n += ids.size
+        if chunk_n < chunk_words:
+            continue
+        flush_chunk()
+        yield from drain_full_batches()
+    flush_chunk()
+    yield from drain_full_batches()
     if pending_count:
         flat = np.concatenate(pending, axis=1)
         centers = np.zeros(batch_size, np.int32)
@@ -170,6 +200,34 @@ def iter_pair_batches(dictionary: Dictionary, corpus_path,
         contexts[:pending_count] = flat[1].astype(np.int32)
         yield PairBatch(centers, contexts, pending_count,
                         float(flat[2].sum()))
+
+
+def chunk_sentence_pairs(ids_list: List[np.ndarray], window: int,
+                         rng: np.random.Generator):
+    """Vectorized (center, context) expansion for MANY sentences at once:
+    the sentences concatenate into one flat array with a per-position
+    sentence id; a context position is valid when it stays inside the
+    flat array, inside the SAME sentence, and within the center's shrunk
+    window. Returns (int32 pairs [2, k], sentence index per pair [k])."""
+    flat = np.concatenate(ids_list)
+    n = flat.size
+    if n == 0:
+        return np.zeros((2, 0), np.int32), np.zeros(0, np.int64)
+    lengths = np.fromiter((a.size for a in ids_list), np.int64,
+                          count=len(ids_list))
+    sent_id = np.repeat(np.arange(len(ids_list)), lengths)
+    shrink = rng.integers(1, window + 1, size=n)
+    offsets = np.concatenate([np.arange(-window, 0),
+                              np.arange(1, window + 1)])
+    pos = np.arange(n)[:, None] + offsets[None, :]  # [n, 2w]
+    inside = (pos >= 0) & (pos < n)
+    pos_c = np.clip(pos, 0, n - 1)
+    valid = inside & (np.abs(offsets)[None, :] <= shrink[:, None]) \
+        & (sent_id[pos_c] == sent_id[:, None])
+    center_idx, off_idx = np.nonzero(valid)
+    pairs = np.stack([flat[center_idx],
+                      flat[pos_c[center_idx, off_idx]]]).astype(np.int32)
+    return pairs, sent_id[center_idx]
 
 
 def sentence_pairs(ids: np.ndarray, window: int,

@@ -74,6 +74,65 @@ class Word2VecConfig:
         self.use_ps = use_ps
 
 
+def build_alias(probs: np.ndarray):
+    """Vose's alias method: O(V) build, O(1) vectorized sampling.
+    Returns (prob[V] float32, alias[V] int32): draw ``i`` uniformly, then
+    take ``i`` with probability ``prob[i]`` else ``alias[i]``."""
+    probs = np.asarray(probs, np.float64)
+    n = probs.size
+    scaled = probs * (n / probs.sum())
+    prob = np.ones(n, np.float32)
+    alias = np.arange(n, dtype=np.int32)
+    # The pairing sweep is a Python O(n) loop: ~1 s per 1M entries, so
+    # ~20 s one-time at the reference's 21M-word vocab — accepted, since
+    # it buys O(1) in-jit sampling every batch (the device searchsorted
+    # it replaces cost ~26 ms per 160K draws, i.e. seconds per epoch).
+    small = list(np.flatnonzero(scaled < 1.0)[::-1])
+    large = list(np.flatnonzero(scaled >= 1.0)[::-1])
+    while small and large:
+        s, g = int(small.pop()), int(large.pop())
+        prob[s] = scaled[s]
+        alias[s] = g
+        scaled[g] = scaled[g] + scaled[s] - 1.0
+        (small if scaled[g] < 1.0 else large).append(g)
+    return prob, alias
+
+
+def _alias_draw_np(prob: np.ndarray, alias: np.ndarray,
+                   rng: np.random.Generator, shape) -> np.ndarray:
+    idx = rng.integers(0, prob.size, size=shape).astype(np.int32)
+    keep = rng.random(size=shape) < prob[idx]
+    return np.where(keep, idx, alias[idx])
+
+
+def _unique_rows_and_remap(ids_list, num_rows: int):
+    """Sorted unique ids over ``ids_list`` plus a remap array such that
+    ``remap[id] = compact slot``. Bitmap-based — O(num_rows + K), ~4x
+    faster than sort-based ``np.unique`` + ``searchsorted`` at word2vec
+    batch shapes — falling back to the sort path when the table is huge
+    relative to the batch (the O(num_rows) sweep would dominate)."""
+    total = sum(a.size for a in ids_list)
+    if num_rows > max(1 << 22, 32 * total):
+        rows = np.unique(np.concatenate(
+            [a.reshape(-1) for a in ids_list])).astype(np.int32)
+        return rows, None
+    mark = np.zeros(num_rows, bool)
+    for a in ids_list:
+        mark[a.reshape(-1)] = True
+    rows = np.flatnonzero(mark).astype(np.int32)
+    remap = np.empty(num_rows, np.int32)
+    remap[rows] = np.arange(rows.size, dtype=np.int32)
+    return rows, remap
+
+
+def _slot_map(rows: np.ndarray, remap, ids: np.ndarray) -> np.ndarray:
+    """Compact slot of every id: remap gather when available, else
+    binary search over the sorted unique rows."""
+    if remap is not None:
+        return remap[ids]
+    return np.searchsorted(rows, ids).astype(np.int32)
+
+
 def _pad_rows(rows: np.ndarray, minimum: int = 8) -> np.ndarray:
     """Pad a sorted unique row-id set to the next power of two (bounded
     set of jit trace shapes) by repeating the last id. Padded slots are
@@ -131,10 +190,13 @@ class Word2Vec:
             self._codes_host = np.asarray(tree.codes)
             self._points_host = np.asarray(tree.points)
             return max(tree.num_inner_nodes, 1)
-        neg = dictionary.negative_table()
-        # float64: a float32 cumsum's last entry can land below 1.0 and
-        # a uniform draw above it would index one past the last word.
-        self._neg_cdf_host = np.cumsum(neg, dtype=np.float64)
+        # Alias-method tables (Vose) over the unigram^0.75 distribution:
+        # a draw is (randint, uniform, two table lookups) — O(1) and fully
+        # vectorized. The inverse-CDF searchsorted it replaces costs
+        # ~26 ms per 160K draws inside the jitted step on TPU (binary
+        # search lowers badly); alias sampling is ~0.1 ms.
+        self._neg_prob_host, self._neg_alias_host = build_alias(
+            dictionary.negative_table())
         return dictionary.size
 
     def _init_embeddings(self) -> None:
@@ -150,8 +212,8 @@ class Word2Vec:
             self._codes_dev = jnp.asarray(self._codes_host)
             self._points_dev = jnp.asarray(self._points_host)
         else:
-            self._neg_cdf_dev = jnp.asarray(
-                self._neg_cdf_host.astype(np.float32))
+            self._neg_prob_dev = jnp.asarray(self._neg_prob_host)
+            self._neg_alias_dev = jnp.asarray(self._neg_alias_host)
         self._key = jax.random.PRNGKey(self.config.seed)
         self._step = self._build_step()
 
@@ -168,40 +230,44 @@ class Word2Vec:
         ref: communicator.cpp:117-155). Pure numpy — run it in the
         loader thread to overlap with device steps."""
         config = self.config
+        vocab = self.dictionary.size
         if isinstance(batch, CbowBatch):
             win, targets = batch.window, batch.centers
             real = win[win >= 0]
-            rows_in = np.unique(real).astype(np.int32) if real.size \
-                else np.zeros(1, np.int32)
-            win_l = np.clip(np.searchsorted(rows_in, np.maximum(win, 0)),
+            if real.size:
+                rows_in, remap = _unique_rows_and_remap([real], vocab)
+            else:
+                rows_in, remap = np.zeros(1, np.int32), None
+            win_l = np.clip(_slot_map(rows_in, remap, np.maximum(win, 0)),
                             0, rows_in.size - 1).astype(np.int32)
             in_args = (win_l, (win >= 0).astype(np.float32))
             size = batch.centers.shape[0]
         else:
             centers, targets = batch.centers, batch.contexts
-            rows_in = np.unique(centers).astype(np.int32)
-            in_args = (np.searchsorted(rows_in, centers).astype(np.int32),)
+            rows_in, remap = _unique_rows_and_remap([centers], vocab)
+            in_args = (_slot_map(rows_in, remap, centers),)
             size = centers.shape[0]
 
         if config.hs:
             points = self._points_host[targets]  # [B, L], -1 padded
             real = points[points >= 0]
-            rows_out = np.unique(real).astype(np.int32) if real.size \
-                else np.zeros(1, np.int32)
+            if real.size:
+                rows_out, remap = _unique_rows_and_remap(
+                    [real], self._out_rows)
+            else:
+                rows_out, remap = np.zeros(1, np.int32), None
             points_l = np.clip(
-                np.searchsorted(rows_out, np.maximum(points, 0)),
+                _slot_map(rows_out, remap, np.maximum(points, 0)),
                 0, rows_out.size - 1).astype(np.int32)
             out_args = (points_l, self._codes_host[targets])
         else:
             k = config.negative
-            neg = np.minimum(
-                np.searchsorted(self._neg_cdf_host,
-                                self._rng.random((targets.size, k))),
-                self.dictionary.size - 1).astype(np.int32)
-            rows_out = np.unique(
-                np.concatenate([targets, neg.reshape(-1)])).astype(np.int32)
-            out_args = (np.searchsorted(rows_out, targets).astype(np.int32),
-                        np.searchsorted(rows_out, neg).astype(np.int32))
+            neg = _alias_draw_np(self._neg_prob_host,
+                                 self._neg_alias_host, self._rng,
+                                 (targets.size, k)).astype(np.int32)
+            rows_out, remap = _unique_rows_and_remap([targets, neg], vocab)
+            out_args = (_slot_map(rows_out, remap, targets),
+                        _slot_map(rows_out, remap, neg))
 
         return CompactBatch(
             rows_in=rows_in, rows_out=rows_out,
@@ -290,10 +356,12 @@ class Word2Vec:
                 labels = (1.0 - codes.astype(jnp.float32)) * out_mask
             else:
                 batch = targets.shape[0]
-                uniform = jax.random.uniform(key, (batch, k))
-                negs = jnp.minimum(
-                    jnp.searchsorted(self._neg_cdf_dev, uniform),
-                    self._neg_cdf_dev.shape[0] - 1)
+                k_idx, k_keep = jax.random.split(key)
+                idx = jax.random.randint(
+                    k_idx, (batch, k), 0, self._neg_prob_dev.shape[0])
+                keep = jax.random.uniform(k_keep, (batch, k)) \
+                    < self._neg_prob_dev[idx]
+                negs = jnp.where(keep, idx, self._neg_alias_dev[idx])
                 out_ids = jnp.concatenate([targets[:, None], negs], axis=1)
                 out_mask = pair_mask[:, None] * jnp.ones((1, 1 + k))
                 labels = jnp.concatenate(
@@ -365,14 +433,19 @@ class Word2Vec:
 
     def train_batches(self, iterator) -> Tuple[float, int]:
         """Drive a whole batch stream; returns (loss_sum, pair_count).
-        Device losses accumulate without host syncs (one materialization
-        at the end)."""
-        losses = []
+        Device losses accumulate into ONE device scalar (a lazy ``+``
+        per batch) and materialize once at the end. Any per-batch host
+        read of a device scalar is a full round-trip — tens of ms over a
+        tunneled device — and so is each element of a deferred
+        ``jnp.stack``; the running add keeps exactly one buffer and one
+        final transfer."""
+        acc = None
         pairs = 0
         for batch in iterator:
-            losses.append(self.train_batch_async(batch))
+            loss = self.train_batch_async(batch)
+            acc = loss if acc is None else acc + loss
             pairs += batch.count
-        return float(sum(float(x) for x in losses)), pairs
+        return 0.0 if acc is None else float(acc), pairs
 
     def prepared(self, batches):
         """Adapter for the loader thread. Local mode needs no host
@@ -419,7 +492,7 @@ class _Prep:
 
 
 class _Launched:
-    __slots__ = ("prep", "new_in", "new_out", "old_in", "old_out", "loss")
+    __slots__ = ("prep", "delta_in", "delta_out", "loss")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -476,15 +549,25 @@ class PSWord2Vec(Word2Vec):
         self._num_workers = max(
             zoo.num_workers if self._num_workers_override is None
             else self._num_workers_override, 1)
+        # When every rank shares the process the whole pull->step->push
+        # loop stays in HBM: device row gathers, device delta scatters —
+        # no host round-trips (critical when the host<->device link is
+        # slow relative to HBM). Cross-process transports serialize, so
+        # they take the host-buffer path.
+        self._device_path = zoo.net.in_process
         self._step = self._build_ps_step()
 
     def _build_ps_step(self):
         loss_fn = self._compact_loss()
 
-        def step(ein, eout, lr, in_args, out_args, pair_mask):
+        def step(ein, eout, lr_scaled, in_args, out_args, pair_mask):
+            """One fused jitted step returning the PUSH deltas directly:
+            ``-lr * grad / num_workers`` (the reference's
+            ``(new - old) / num_workers`` with one local step,
+            ref: communicator.cpp:157-249) plus the batch loss."""
             loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                 ein, eout, in_args, out_args, pair_mask)
-            return ein - lr * grads[0], eout - lr * grads[1], loss
+            return -lr_scaled * grads[0], -lr_scaled * grads[1], loss
 
         return jax.jit(step)
 
@@ -492,48 +575,80 @@ class PSWord2Vec(Word2Vec):
     def _prepare(self, batch) -> _Prep:
         compact = batch if isinstance(batch, CompactBatch) \
             else self.prepare(batch)
+        if self._device_path:
+            # Device pull of the PADDED row sets (gather duplicates are
+            # free; the result is already step-shaped in HBM).
+            return _Prep(
+                compact=compact, buf_in=None, buf_out=None,
+                mid_in=self._in_table.get_rows_device_async(
+                    compact.rows_in_p),
+                mid_out=self._out_table.get_rows_device_async(
+                    compact.rows_out_p))
+        # Host path: pull only the REAL unique rows into the head of the
+        # padded buffer (the padded tail is never referenced by the
+        # compact index maps and its deltas are sliced off before the
+        # push, so it only needs to be NaN-free). Requesting the padded
+        # vector instead would ship thousands of duplicates of the last
+        # row over the wire in both directions.
+        n_in, n_out = compact.rows_in.size, compact.rows_out.size
         buf_in = np.empty((compact.rows_in_p.size, self._dim), np.float32)
         buf_out = np.empty((compact.rows_out_p.size, self._dim),
                            np.float32)
+        buf_in[n_in:] = 0.0
+        buf_out[n_out:] = 0.0
         return _Prep(
             compact=compact, buf_in=buf_in, buf_out=buf_out,
-            mid_in=self._in_table.get_rows_async(compact.rows_in_p,
-                                                 out=buf_in),
-            mid_out=self._out_table.get_rows_async(compact.rows_out_p,
-                                                   out=buf_out))
+            mid_in=self._in_table.get_rows_async(compact.rows_in,
+                                                 out=buf_in[:n_in]),
+            mid_out=self._out_table.get_rows_async(compact.rows_out,
+                                                   out=buf_out[:n_out]))
 
     # -- phase 2: wait the pull, dispatch the device step (async) --
     def _launch(self, prep: _Prep) -> _Launched:
         compact = prep.compact
         self._in_table.wait(prep.mid_in)
         self._out_table.wait(prep.mid_out)
-        old_in = jnp.asarray(prep.buf_in)
-        old_out = jnp.asarray(prep.buf_out)
-        new_in, new_out, loss = self._step(
-            old_in, old_out, jnp.float32(self.learning_rate()),
+        if self._device_path:
+            old_in = self._in_table.take_device_rows()
+            old_out = self._out_table.take_device_rows()
+        else:
+            old_in = jnp.asarray(prep.buf_in)
+            old_out = jnp.asarray(prep.buf_out)
+        lr_scaled = jnp.float32(self.learning_rate() / self._num_workers)
+        delta_in, delta_out, loss = self._step(
+            old_in, old_out, lr_scaled,
             tuple(jnp.asarray(a) for a in compact.in_args),
             tuple(jnp.asarray(a) for a in compact.out_args),
             self._pair_mask_for(compact.count, compact.size))
-        return _Launched(prep=prep, new_in=new_in, new_out=new_out,
-                         old_in=old_in, old_out=old_out, loss=loss)
+        return _Launched(prep=prep, delta_in=delta_in,
+                         delta_out=delta_out, loss=loss)
 
-    # -- phase 3: materialize deltas, push, account words --
-    def _finish(self, launched: _Launched) -> float:
+    # -- phase 3: push deltas, account words --
+    def _finish(self, launched: _Launched):
+        """Push this batch's deltas (device arrays stay in HBM on the
+        device path) and return the batch loss as a DEVICE scalar — the
+        hot loop must not synchronize on it."""
         compact = launched.prep.compact
-        scale = 1.0 / self._num_workers
-        delta_in = np.asarray((launched.new_in - launched.old_in) * scale)
-        delta_out = np.asarray((launched.new_out - launched.old_out)
-                               * scale)
-        self._pending_pushes.append((self._in_table,
-                                     self._in_table.add_rows_async(
-                                         compact.rows_in,
-                                         delta_in[:compact.rows_in.size])))
-        self._pending_pushes.append((self._out_table,
-                                     self._out_table.add_rows_async(
-                                         compact.rows_out,
-                                         delta_out[:compact.rows_out.size])))
+        if self._device_path:
+            # Padded device push: padded slots carry exactly-zero deltas
+            # (their rows got no gradient), so the duplicate trailing ids
+            # scatter-add zeros — a no-op.
+            push_in, rows_in = launched.delta_in, compact.rows_in_p
+            push_out, rows_out = launched.delta_out, compact.rows_out_p
+        else:
+            push_in = np.asarray(launched.delta_in)[:compact.rows_in.size]
+            rows_in = compact.rows_in
+            push_out = np.asarray(
+                launched.delta_out)[:compact.rows_out.size]
+            rows_out = compact.rows_out
+        self._pending_pushes.append(
+            (self._in_table,
+             self._in_table.add_rows_async(rows_in, push_in)))
+        self._pending_pushes.append(
+            (self._out_table,
+             self._out_table.add_rows_async(rows_out, push_out)))
         self._account_words(compact.words)
-        return float(launched.loss) / max(compact.count, 1)
+        return launched.loss
 
     def _drain_pushes(self) -> None:
         """Wait every outstanding Add ack: a barrier alone orders only
@@ -571,9 +686,10 @@ class PSWord2Vec(Word2Vec):
             yield self.prepare(batch)
 
     def train_batch(self, batch) -> float:
-        loss = self._finish(self._launch(self._prepare(batch)))
+        launched = self._launch(self._prepare(batch))
+        loss = self._finish(launched)
         self._drain_pushes()
-        return loss
+        return float(loss) / max(launched.prep.compact.count, 1)
 
     def train_batch_async(self, batch):
         return jnp.float32(self.train_batch(batch))
@@ -581,27 +697,29 @@ class PSWord2Vec(Word2Vec):
     def train_batches(self, iterator) -> Tuple[float, int]:
         """Pipelined loop: batch i+1's row pull is serviced by the server
         actors while batch i's step runs on device and its deltas push
-        (ref overlap: distributed_wordembedding.cpp:203-224)."""
-        loss_sum = 0.0
+        (ref overlap: distributed_wordembedding.cpp:203-224). Losses
+        accumulate as device scalars — one host materialization at the
+        end, no per-batch syncs."""
+        acc = None
         pairs = 0
         launched: Optional[_Launched] = None
         for batch in iterator:
             prep = self._prepare(batch)  # async pull in flight
             if launched is not None:
-                loss_sum += self._finish(launched) \
-                    * max(launched.prep.compact.count, 1)
+                loss = self._finish(launched)
+                acc = loss if acc is None else acc + loss
                 pairs += launched.prep.compact.count
             launched = self._launch(prep)
         if launched is not None:
-            loss_sum += self._finish(launched) \
-                * max(launched.prep.compact.count, 1)
+            loss = self._finish(launched)
+            acc = loss if acc is None else acc + loss
             pairs += launched.prep.compact.count
         # Every push acked, trailing word count published, then the
         # barrier: a peer's post-barrier read sees all of our updates.
         self._drain_pushes()
         self._flush_word_count()
         self._in_table.zoo.barrier()
-        return loss_sum, pairs
+        return 0.0 if acc is None else float(acc), pairs
 
     @property
     def embeddings(self) -> np.ndarray:

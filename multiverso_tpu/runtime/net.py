@@ -35,6 +35,11 @@ from ..util.mt_queue import MtQueue
 class NetInterface:
     """Abstract transport (ref: include/multiverso/net.h:15-49)."""
 
+    #: True when every rank shares this OS process (messages pass by
+    #: reference, so Blob payloads — including device arrays — arrive
+    #: zero-copy). Transports that serialize to a wire set this False.
+    in_process = False
+
     @property
     def rank(self) -> int:
         raise NotImplementedError
@@ -160,6 +165,8 @@ class LocalFabric:
 
 
 class LocalNet(NetInterface):
+    in_process = True
+
     def __init__(self, fabric: LocalFabric, rank: int):
         self._fabric = fabric
         self._rank = rank

@@ -122,7 +122,7 @@ class MatrixWorker(WorkerTable):
         # registers, ref: matrix_table.cpp:66-76). _dest xor _device_shards
         # names the reply destination.
         self._dest: Optional[np.ndarray] = None
-        self._dest_rows: Optional[Dict[int, int]] = None
+        self._dest_rows: Optional[np.ndarray] = None  # requested row-id vector
         self._device_shards: Optional[Dict[int, object]] = None
 
     # -- Get API (ref: matrix_table.cpp:58-105) --
@@ -154,14 +154,49 @@ class MatrixWorker(WorkerTable):
             out = np.empty((row_ids.size, self.num_col), self.dtype)
         CHECK(out.shape == (row_ids.size, self.num_col), "bad output shape")
         self._dest = out
-        # A row id may appear more than once (e.g. power-of-two padded row
-        # sets repeat the last id); every requested position must be
-        # filled, not just the last.
-        self._dest_rows = {}
-        for i, r in enumerate(row_ids):
-            self._dest_rows.setdefault(int(r), []).append(i)
+        # The requested id vector, kept for reply placement. Ids may
+        # repeat (e.g. power-of-two padded row sets repeat the last id);
+        # every requested position gets its id's row.
+        self._dest_rows = row_ids
         self._device_shards = None
         return self._request_get(Blob(row_ids.view(np.uint8)))
+
+    def get_rows_device(self, row_ids):
+        """Device-resident row pull: returns ``[k, num_col]`` as a
+        ``jax.Array`` assembled from per-server device shards — zero host
+        copies when the servers share the process (the TPU-native hot
+        path: the reference's RequestParameter row pull,
+        communicator.cpp:117-155, without ever leaving HBM)."""
+        self.wait(self.get_rows_device_async(row_ids))
+        return self.take_device_rows()
+
+    def get_rows_device_async(self, row_ids) -> int:
+        """Async device row pull. ``row_ids`` must be non-decreasing so
+        each server's reply is one contiguous segment and the result
+        reassembles by concatenation (sorted-unique row sets — possibly
+        tail-padded by repeating the last id — satisfy this)."""
+        row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
+        CHECK(row_ids.size > 0, "empty device row get")
+        CHECK(not self._compress, "device gets bypass wire compression")
+        if self._num_server > 1:
+            CHECK(bool(np.all(np.diff(row_ids) >= 0)),
+                  "device row gets need sorted row ids")
+        self._dest, self._dest_rows = None, None
+        self._device_shards = {}
+        return self._request_get(Blob(row_ids.view(np.uint8)))
+
+    def take_device_rows(self):
+        """Assembled result of the last ``get_rows_device_async`` (call
+        after ``wait``); clears the reply slot."""
+        shards = self._device_shards
+        CHECK(shards is not None and len(shards) > 0,
+              "no device row get outstanding")
+        self._device_shards = None
+        ordered = [shards[sid] for sid in sorted(shards)]
+        if len(ordered) == 1:
+            return ordered[0]
+        import jax.numpy as jnp
+        return jnp.concatenate(ordered, axis=0)
 
     def _request_get(self, keys: Blob) -> int:
         extra = []
@@ -191,11 +226,17 @@ class MatrixWorker(WorkerTable):
 
     def add_rows_async(self, row_ids, delta,
                        option: Optional[AddOption] = None) -> int:
+        """Row-delta push. A ``jax.Array`` delta stays on device end to
+        end when the servers share the process (scatter-add straight from
+        HBM — the device twin of the reference's AddDeltaParameter,
+        communicator.cpp:157-249)."""
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
-        delta = np.ascontiguousarray(delta, self.dtype)
-        CHECK(delta.size == row_ids.size * self.num_col, "bad delta size")
+        if not is_device_array(delta):
+            delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
+        CHECK(int(np.prod(delta.shape)) == row_ids.size * self.num_col,
+              "bad delta size")
         return self.add_async_raw(Blob(row_ids.view(np.uint8)),
-                                  Blob(delta.reshape(-1)),
+                                  Blob(delta),
                                   self._option_blob(option))
 
     def _option_blob(self, option: Optional[AddOption]) -> Blob:
@@ -233,12 +274,29 @@ class MatrixWorker(WorkerTable):
         # (ref: matrix_table.cpp:267-276).
         is_add = msg_type == MsgType.Request_Add
         dest = np.minimum(keys // self._row_length, self._num_server - 1)
-        values = blobs[1].as_array(self.dtype).reshape(
-            keys.size, self.num_col) if is_add else None
+        values = dev_values = None
+        if is_add:
+            if blobs[1].on_device and not self._compress:
+                # Device delta: slice per-server segments in HBM (keys
+                # must be sorted for multi-server so segments are
+                # contiguous; single-server always passes whole).
+                dev_values = blobs[1].typed(self.dtype).reshape(
+                    keys.size, self.num_col)
+                if self._num_server > 1:
+                    CHECK(bool(np.all(np.diff(dest) >= 0)),
+                          "device row adds need sorted row ids")
+            else:
+                values = blobs[1].as_array(self.dtype).reshape(
+                    keys.size, self.num_col)
         for sid in np.unique(dest):
             mask = dest == sid
             shard = [Blob(np.ascontiguousarray(keys[mask]).view(np.uint8))]
-            if values is not None:
+            if dev_values is not None:
+                lo, hi = np.searchsorted(dest, [sid, sid + 1])
+                shard.append(Blob(dev_values[lo:hi]))
+                if len(blobs) == 3:
+                    shard.append(blobs[2])
+            elif values is not None:
                 chunk = np.ascontiguousarray(values[mask])
                 if self._compress:
                     shard.extend(_compress_values(chunk))
@@ -281,6 +339,15 @@ class MatrixWorker(WorkerTable):
             values = reply_blobs[1].as_array(self.dtype)
             self._dest[lo:hi] = values.reshape(hi - lo, self.num_col)
             return
+        if self._device_shards is not None:
+            # Device row pull: keep the server's gather result in HBM,
+            # keyed by the owning server (a shard carries one server's
+            # contiguous key segment).
+            sid = int(min(keys[0] // self._row_length,
+                          self._num_server - 1))
+            self._device_shards[sid] = reply_blobs[1].typed(
+                self.dtype).reshape(keys.size, self.num_col)
+            return
         if self._compress and len(reply_blobs) == 3:
             values = _decompress_values(
                 reply_blobs[1], reply_blobs[2],
@@ -292,9 +359,20 @@ class MatrixWorker(WorkerTable):
             # Sparse whole-table get: dirty rows land at their global index.
             self._dest[keys] = values
         else:
-            for i, key in enumerate(keys):
-                for pos in self._dest_rows[int(key)]:
-                    self._dest[pos] = values[i]
+            # Vectorized placement: every requested position whose row id
+            # appears in THIS reply shard gets that row's value (a shard
+            # carries one server's key subset; positions for other servers'
+            # keys are left for their shards). Requests may repeat ids —
+            # power-of-two padded row sets repeat the last id thousands of
+            # times, so per-position Python loops go quadratic and a single
+            # reply can burn minutes.
+            req = self._dest_rows
+            sorter = np.argsort(keys, kind="stable")
+            sorted_keys = keys[sorter]
+            slot = np.searchsorted(sorted_keys, req)
+            slot = np.minimum(slot, sorted_keys.size - 1)
+            hit = sorted_keys[slot] == req
+            self._dest[hit] = values[sorter[slot[hit]]]
 
 
 class MatrixServer(ServerTable):
@@ -368,7 +446,10 @@ class MatrixServer(ServerTable):
                 self._mark_dirty(slice(None), option)
             return
         local_rows = keys - self.row_offset
-        delta = np.asarray(delta).reshape(keys.size, self.num_col)
+        if is_device_array(delta):
+            delta = delta.reshape(keys.size, self.num_col)
+        else:
+            delta = np.asarray(delta).reshape(keys.size, self.num_col)
         self._data = self._engine.apply_rows(self._data, local_rows, delta,
                                              option)
         if self._up_to_date is not None:

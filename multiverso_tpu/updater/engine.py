@@ -104,16 +104,22 @@ def pad_ids(row_ids, num_rows: int) -> np.ndarray:
 
 def pad_rows(row_ids, delta, num_rows: int):
     """Pad (row_ids, delta) to the next bucket size; padding rows index
-    out-of-range so scatter drops them and gather fills zeros."""
+    out-of-range so scatter drops them and gather fills zeros. Device
+    deltas pad on device (already-bucketed sizes pass through untouched —
+    the zero-copy hot path)."""
     row_ids = np.asarray(row_ids, dtype=np.int32)
     k = row_ids.shape[0]
     b = bucket_size(k)
     if b != k:
         row_ids = np.concatenate(
             [row_ids, np.full(b - k, num_rows, dtype=np.int32)])
-        pad_shape = (b - k,) + tuple(np.shape(delta))[1:]
-        delta = np.concatenate(
-            [np.asarray(delta), np.zeros(pad_shape, np.asarray(delta).dtype)])
+        pad = ((0, b - k),) + ((0, 0),) * (len(np.shape(delta)) - 1)
+        from ..core.blob import is_device_array
+        if is_device_array(delta):
+            import jax.numpy as jnp
+            delta = jnp.pad(delta, pad)
+        else:
+            delta = np.pad(np.asarray(delta), pad)
     return row_ids, delta
 
 

@@ -251,13 +251,19 @@ class TestPSWord2Vec:
                                     batch_size=128, sample=0, use_ps=True)
             model = PSWord2Vec(config, d)
             pulled = []
-            orig = model._in_table.get_rows_async
+            orig_host = model._in_table.get_rows_async
+            orig_dev = model._in_table.get_rows_device_async
 
-            def spy(rows, out=None):
+            def spy_host(rows, out=None):
                 pulled.append(len(rows))
-                return orig(rows, out=out)
+                return orig_host(rows, out=out)
 
-            model._in_table.get_rows_async = spy
+            def spy_dev(rows):
+                pulled.append(len(rows))
+                return orig_dev(rows)
+
+            model._in_table.get_rows_async = spy_host
+            model._in_table.get_rows_device_async = spy_dev
             loss_sum, pairs = model.train_batches(iter_pair_batches(
                 d, str(path), batch_size=128, window=2, subsample=0))
             assert pairs > 0 and np.isfinite(loss_sum)
