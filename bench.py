@@ -42,7 +42,7 @@ EPOCHS = 3
 BATCH = 32768
 DIM = 128
 NEG = 5
-PS_MAX_BATCHES = 120  # cap the timed PS segment (words/s is a rate)
+PS_MAX_BATCHES = 240  # cap the timed PS segment (words/s is a rate)
 
 # Nominal per-chip peaks for utilization reporting (dense matmul peak for
 # the compute dtype class; memory bandwidth). Conservative defaults.

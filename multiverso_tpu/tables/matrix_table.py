@@ -315,13 +315,7 @@ class MatrixWorker(WorkerTable):
               "device get is for dense tables (sparse replies are ragged)")
         self._dest, self._dest_rows, self._device_shards = None, None, {}
         self.wait(self._request_get(Blob(_ALL_KEY.view(np.uint8))))
-        shards = [self._device_shards[sid]
-                  for sid in range(len(self._device_shards))]
-        self._device_shards = None
-        if len(shards) == 1:
-            return shards[0]
-        import jax.numpy as jnp
-        return jnp.concatenate(shards, axis=0)
+        return self.take_device_rows()
 
     # -- replies (ref: matrix_table.cpp:317-341) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:

@@ -220,3 +220,31 @@ print("C_ABI_OK")
                      MULTIVERSO_LIB=LIB_PATH))
         assert "LUA_BINDING_OK" in result.stdout, \
             result.stdout[-400:] + result.stderr[-800:]
+
+
+class TestExamples:
+    """The shipped binding examples must actually run (the reference's
+    theano/keras examples double as smoke tests in its CI,
+    ref: deploy/docker/Dockerfile:96-99)."""
+
+    def _run(self, name, workers):
+        example = os.path.join(BINDING_PATH, "examples", name)
+        result = subprocess.run(
+            [sys.executable, example, f"-workers={workers}"],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, PYTHONPATH=os.pathsep.join(
+                [REPO, BINDING_PATH])),
+            cwd=REPO)
+        assert result.returncode == 0, result.stderr[-1200:]
+        return result.stdout
+
+    def test_jax_logreg_example_two_workers(self):
+        out = self._run("jax_logistic_regression.py", 2)
+        accs = [float(a.strip("'\" ,[]")) for a in
+                out.split("accuracy:")[1].split()]
+        assert all(a > 0.8 for a in accs), out  # learns, not just runs
+
+    def test_torch_mlp_example_two_workers(self):
+        pytest.importorskip("torch")
+        out = self._run("torch_mlp.py", 2)
+        assert "accuracy" in out, out
