@@ -283,6 +283,16 @@ def matrix_bandwidth() -> dict:
     t0 = time.perf_counter()
     np.asarray(fresh)
     down_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+    # Per-call dispatch floor: how long one tiny jitted op takes to
+    # dispatch AND complete. On a tunneled device this floor (not
+    # compute) often bounds words/s — report it so rates are readable.
+    tiny = jax.jit(lambda x: x + 1.0)
+    s0 = jax.block_until_ready(tiny(jnp.float32(0)))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        s0 = jax.block_until_ready(tiny(s0))  # block EACH call: the
+        # async pipeline would otherwise hide the per-call roundtrip
+    dispatch_ms = (time.perf_counter() - t0) / 20 * 1e3
 
     # Sparse dirty-row path (ref: test_matrix_perf.cpp sparse variants):
     # dirty rows per round, dirty-only whole-table get.
@@ -311,7 +321,8 @@ def matrix_bandwidth() -> dict:
             "get_gbps": round(get_gbps, 3),
             "sparse_dirty_roundtrip_gbps": round(sparse_gbps, 3),
             "tunnel_upload_mbps": round(up_mbps, 1),
-            "tunnel_download_mbps": round(down_mbps, 1)}
+            "tunnel_download_mbps": round(down_mbps, 1),
+            "dispatch_roundtrip_ms": round(dispatch_ms, 3)}
 
 
 def _phase(name: str, fn, *args, **kw):

@@ -24,10 +24,10 @@ to compact slots. Then:
   117-249), pipelined across batches (ref: distributed_wordembedding.
   cpp:203-224).
 
-Negatives are host-sampled by inverse-CDF over the unigram^0.75
-distribution in float64 (the row set must be known before the device
-step; float32 CDF tails can round below 1.0 and index past the vocab).
-The learning rate decays linearly in processed words (ref:
+Negatives sample from the unigram^0.75 distribution via Vose alias
+tables — in-jit on the local path, host-side (numpy) on the PS path,
+where the row set must be known before the pull. The learning rate
+decays linearly in processed words (ref:
 distributed_wordembedding.cpp:92-134; in PS mode the global count rides
 a KV table)."""
 
@@ -347,6 +347,7 @@ class Word2Vec:
             return emb_in[in_ids], None
 
         def step(emb_in, emb_out, lr, key, pair_mask, in_ids, targets):
+            next_key, key = jax.random.split(key)
             if config.hs:
                 points = self._points_dev[targets]  # [B, L]
                 codes = self._codes_dev[targets]
@@ -398,7 +399,10 @@ class Word2Vec:
                 loss_fn, argnums=(0, 1))(vecs, u)
             new_in = emb_in.at[in_gather].add(-lr * g_vecs)
             new_out = emb_out.at[out_ids].add(-lr * g_u)
-            return new_in, new_out, loss
+            # The next PRNG key comes back as a step OUTPUT: splitting on
+            # the host would be one more device call per batch, and each
+            # call pays the transport's dispatch latency.
+            return new_in, new_out, loss, next_key
 
         return jax.jit(step, donate_argnums=(0, 1))
 
@@ -418,10 +422,9 @@ class Word2Vec:
         else:
             in_ids, targets = batch.centers, batch.contexts
         size = batch.centers.shape[0]
-        self._key, subkey = jax.random.split(self._key)
-        self._emb_in, self._emb_out, loss = self._step(
+        self._emb_in, self._emb_out, loss, self._key = self._step(
             self._emb_in, self._emb_out,
-            jnp.float32(self.learning_rate()), subkey,
+            jnp.float32(self.learning_rate()), self._key,
             self._pair_mask_for(batch.count, size),
             jnp.asarray(in_ids), jnp.asarray(targets))
         self.trained_words += batch.words

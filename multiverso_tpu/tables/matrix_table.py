@@ -69,6 +69,22 @@ def _decompress_values(values_blob: Blob, sizes_blob: Blob,
     return SparseFilter().filter_out([raw], sizes, dtype=dtype)[0]
 
 
+def _shaped_rows(values, n_rows: int, num_col: int):
+    """Reshape to [n_rows, num_col] only when needed (a no-op reshape on
+    a device array still dispatches a device op)."""
+    if tuple(np.shape(values)) != (n_rows, num_col):
+        values = values.reshape(n_rows, num_col)
+    return values
+
+
+def _trim_rows(values, n_rows: int):
+    """Slice gather output down to the real row count only when padding
+    added rows (full-range device slices still dispatch)."""
+    if values.shape[0] != n_rows:
+        values = values[:n_rows]
+    return values
+
+
 def row_offsets(num_row: int, num_servers: int) -> List[int]:
     """Row ranges per server incl. the degenerate rows<servers layout
     (ref: matrix_table.cpp:24-41). Returns num_actual_servers+1 offsets."""
@@ -339,8 +355,8 @@ class MatrixWorker(WorkerTable):
             # contiguous key segment).
             sid = int(min(keys[0] // self._row_length,
                           self._num_server - 1))
-            self._device_shards[sid] = reply_blobs[1].typed(
-                self.dtype).reshape(keys.size, self.num_col)
+            self._device_shards[sid] = _shaped_rows(
+                reply_blobs[1].typed(self.dtype), keys.size, self.num_col)
             return
         if self._compress and len(reply_blobs) == 3:
             values = _decompress_values(
@@ -441,7 +457,7 @@ class MatrixServer(ServerTable):
             return
         local_rows = keys - self.row_offset
         if is_device_array(delta):
-            delta = delta.reshape(keys.size, self.num_col)
+            delta = _shaped_rows(delta, keys.size, self.num_col)
         else:
             delta = np.asarray(delta).reshape(keys.size, self.num_col)
         self._data = self._engine.apply_rows(self._data, local_rows, delta,
@@ -474,7 +490,8 @@ class MatrixServer(ServerTable):
                     Blob(np.array([self.server_id], dtype=np.int32))]
         local_rows = keys - self.row_offset
         padded_rows = pad_ids(local_rows, self._data.shape[0])
-        values = self._gather(self._data, padded_rows)[:keys.size]
+        values = _trim_rows(self._gather(self._data, padded_rows),
+                            keys.size)
         if self._up_to_date is not None and len(blobs) >= 2:
             opt = GetOption.from_blob(blobs[1])
             if 0 <= opt.worker_id < self._up_to_date.shape[0]:
@@ -496,7 +513,8 @@ class MatrixServer(ServerTable):
         dirty = np.nonzero(~self._up_to_date[wid])[0].astype(np.int32)
         self._up_to_date[wid, dirty] = True
         padded_rows = pad_ids(dirty, self._data.shape[0])
-        values = self._gather(self._data, padded_rows)[:dirty.size]
+        values = _trim_rows(self._gather(self._data, padded_rows),
+                            dirty.size)
         return [Blob(dirty + self.row_offset)] + self._reply_values(values)
 
     @functools.cached_property
