@@ -7,9 +7,9 @@
 //  - float only: the c_api ABI is float-only (ref: include/multiverso/
 //    c_api.h:28-54), so the `generic <class Type>` surface collapses to
 //    float[] overloads.
-//  - NetBind/NetConnect: deployment bootstrap is driven through MV_Init
-//    argv flags (-machine_file/-port, the TCP transport's bind+connect
-//    path) rather than separate entry points.
+//  - NetBind/NetConnect call the shim's MV_NetBind/MV_NetConnect
+//    exports (app-driven TCP bootstrap); the -machine_file/-port argv
+//    flags on Init remain the machine-file alternative.
 
 using System;
 using System.Collections.Generic;
@@ -54,6 +54,23 @@ namespace Multiverso
         public static int ServerId() { return NativeMethods.MV_ServerId(); }
 
         public static void Barrier() { NativeMethods.MV_Barrier(); }
+
+        // App-driven TCP bootstrap (ref: MultiversoCLR.h NetBind/NetConnect):
+        // declare this process's endpoint, then every rank's, before Init.
+        public static void NetBind(int rank, string endpoint)
+        {
+            NativeMethods.MV_NetBind(rank, endpoint);
+        }
+
+        public static void NetConnect(int[] ranks, string[] endpoints)
+        {
+            if (ranks.Length != endpoints.Length)
+            {
+                throw new ArgumentException(
+                    "ranks and endpoints must have the same length");
+            }
+            NativeMethods.MV_NetConnect(ranks, endpoints, ranks.Length);
+        }
 
         public static void CreateTables(int[] rows, int[] cols)
         {

@@ -27,6 +27,12 @@ namespace Multiverso
         [DllImport(LibName, EntryPoint = "MV_Barrier")]
         internal static extern void MV_Barrier();
 
+        [DllImport(LibName, EntryPoint = "MV_NetBind")]
+        internal static extern void MV_NetBind(int rank, string endpoint);
+
+        [DllImport(LibName, EntryPoint = "MV_NetConnect")]
+        internal static extern void MV_NetConnect(int[] ranks, string[] endpoints, int size);
+
         [DllImport(LibName, EntryPoint = "MV_NumWorkers")]
         internal static extern int MV_NumWorkers();
 

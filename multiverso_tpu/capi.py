@@ -28,6 +28,18 @@ def barrier() -> None:
     mv.barrier()
 
 
+def net_bind(rank: int, endpoint: str) -> None:
+    """MV_NetBind (ref: multiverso.h:55-59): declare this process's rank
+    and TCP endpoint before init — app-driven deployment without a
+    machine file."""
+    mv.net_bind(int(rank), endpoint)
+
+
+def net_connect(ranks, endpoints) -> None:
+    """MV_NetConnect (ref: multiverso.h:60-64)."""
+    mv.net_connect([int(r) for r in ranks], list(endpoints))
+
+
 def num_workers() -> int:
     return mv.num_workers()
 

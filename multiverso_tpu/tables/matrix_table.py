@@ -229,11 +229,11 @@ class MatrixWorker(WorkerTable):
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
         """Whole-table add; device arrays stay on device end to end."""
         if not is_device_array(delta):
-            delta = np.ascontiguousarray(delta, self.dtype)
+            delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
         CHECK(int(np.prod(delta.shape)) == self.num_row * self.num_col,
               "bad delta size")
         return self.add_async_raw(Blob(_ALL_KEY.view(np.uint8)),
-                                  Blob(delta.reshape(-1)),
+                                  Blob(delta),
                                   self._option_blob(option))
 
     def add_rows(self, row_ids, delta,
@@ -270,11 +270,16 @@ class MatrixWorker(WorkerTable):
             values = blobs[1].typed(self.dtype) if is_add else None
             if compress and is_device_array(values):
                 values = np.asarray(values)  # host bytes at the wire
+            # Values may arrive flat [R*C] (host callers) or row-shaped
+            # [R, C] (device deltas skip the flatten — a device reshape
+            # still dispatches); slice in whichever layout they came.
+            row_shaped = values is not None and np.ndim(values) == 2
             for sid in range(self._num_server):
                 shard = [blobs[0]]
                 if values is not None:
                     lo, hi = self._offsets[sid], self._offsets[sid + 1]
-                    chunk = values[lo * self.num_col:hi * self.num_col]
+                    chunk = values[lo:hi] if row_shaped \
+                        else values[lo * self.num_col:hi * self.num_col]
                     if compress:
                         shard.extend(_compress_values(np.asarray(chunk)))
                     else:
@@ -296,8 +301,8 @@ class MatrixWorker(WorkerTable):
                 # Device delta: slice per-server segments in HBM (keys
                 # must be sorted for multi-server so segments are
                 # contiguous; single-server always passes whole).
-                dev_values = blobs[1].typed(self.dtype).reshape(
-                    keys.size, self.num_col)
+                dev_values = _shaped_rows(blobs[1].typed(self.dtype),
+                                          keys.size, self.num_col)
                 if self._num_server > 1:
                     CHECK(bool(np.all(np.diff(dest) >= 0)),
                           "device row adds need sorted row ids")
@@ -451,7 +456,8 @@ class MatrixServer(ServerTable):
             CHECK(int(np.prod(delta.shape)) == self.my_rows * self.num_col,
                   "whole-table add size mismatch")
             self._data = self._engine.apply_dense(
-                self._data, delta.reshape(self.my_rows, self.num_col), option)
+                self._data,
+                _shaped_rows(delta, self.my_rows, self.num_col), option)
             if self._up_to_date is not None:
                 self._mark_dirty(slice(None), option)
             return

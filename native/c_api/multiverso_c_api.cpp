@@ -26,6 +26,17 @@ namespace {
 
 bool g_owns_interpreter = false;
 
+// Bring up the embedded interpreter when a non-Python host calls any
+// entry point before MV_Init (MV_NetBind/MV_NetConnect legitimately run
+// first); acquiring the GIL on an uninitialized runtime is fatal.
+void ensure_interpreter() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Release the GIL so Gil{} works uniformly afterwards.
+    PyEval_SaveThread();
+  }
+}
+
 struct Gil {
   PyGILState_STATE state;
   Gil() : state(PyGILState_Ensure()) {}
@@ -80,11 +91,9 @@ typedef void* TableHandler;
 
 void MV_Init(int* argc, char* argv[]) {
   if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
     g_owns_interpreter = true;
-    // Release the GIL so Gil{} works uniformly afterwards.
-    PyEval_SaveThread();
   }
+  ensure_interpreter();
   Gil gil;
   PyObject* args_list = PyList_New(0);
   int n = (argc != nullptr) ? *argc : 0;
@@ -109,6 +118,36 @@ void MV_ShutDown() {
 void MV_Barrier() {
   Gil gil;
   Py_XDECREF(call("barrier", nullptr));
+}
+
+// App-driven deployment without a machine file — the reference's C++ API
+// pair (ref: include/multiverso/multiverso.h:55-64, zmq_net.h:63-109):
+// MV_NetBind declares this process's rank + endpoint, MV_NetConnect
+// supplies every rank's endpoint; a following MV_Init then bootstraps
+// the TCP mesh from this instead of -machine_file.
+void MV_NetBind(int rank, char* endpoint) {
+  ensure_interpreter();
+  Gil gil;
+  Py_XDECREF(call("net_bind",
+                  Py_BuildValue("(is)", rank, endpoint ? endpoint : "")));
+}
+
+void MV_NetConnect(int* ranks, char* endpoints[], int size) {
+  ensure_interpreter();
+  Gil gil;
+  PyObject* rank_list = PyList_New(0);
+  PyObject* endpoint_list = PyList_New(0);
+  for (int i = 0; i < size; ++i) {
+    PyObject* r = PyLong_FromLong(ranks ? ranks[i] : i);
+    PyList_Append(rank_list, r);
+    Py_DECREF(r);
+    PyObject* e = PyUnicode_FromString(
+        (endpoints && endpoints[i]) ? endpoints[i] : "");
+    PyList_Append(endpoint_list, e);
+    Py_DECREF(e);
+  }
+  Py_XDECREF(call("net_connect",
+                  Py_BuildValue("(NN)", rank_list, endpoint_list)));
 }
 
 int MV_NumWorkers() {

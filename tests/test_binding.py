@@ -175,6 +175,39 @@ print("C_ABI_OK")
             timeout=240, env=dict(os.environ, PYTHONPATH=REPO))
         assert "C_ABI_OK" in result.stdout, result.stderr[-800:]
 
+    def test_net_bind_connect_in_subprocess(self):
+        # MV_NetBind/MV_NetConnect (ref: multiverso.h:55-64): app-driven
+        # TCP bootstrap through the C ABI — a single-rank mesh binds,
+        # connects to itself, and runs a table roundtrip over TCP.
+        code = f"""
+import ctypes, socket, numpy as np
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+lib = ctypes.CDLL({LIB_PATH!r})
+ep = f"127.0.0.1:{{port}}".encode()
+lib.MV_NetBind(0, ctypes.c_char_p(ep))
+ranks = (ctypes.c_int * 1)(0)
+eps = (ctypes.c_char_p * 1)(ep)
+lib.MV_NetConnect(ranks, eps, 1)
+args = [b"prog"]
+lib.MV_Init(ctypes.pointer(ctypes.c_int(1)), (ctypes.c_char_p * 1)(*args))
+h = ctypes.c_void_p()
+lib.MV_NewArrayTable(4, ctypes.byref(h))
+fp = ctypes.POINTER(ctypes.c_float)
+data = np.full(4, 2.0, dtype=np.float32)
+lib.MV_AddArrayTable(h, data.ctypes.data_as(fp), 4)
+out = np.zeros(4, dtype=np.float32)
+lib.MV_GetArrayTable(h, out.ctypes.data_as(fp), 4)
+assert (out == 2.0).all(), out
+lib.MV_ShutDown()
+print("NET_BIND_OK")
+"""
+        result = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=240, env=dict(os.environ, PYTHONPATH=REPO,
+                                  JAX_PLATFORMS="cpu"))
+        assert "NET_BIND_OK" in result.stdout, result.stderr[-800:]
+
     def test_csharp_binding_abi(self):
         # The C# binding is pure P/Invoke source (ref: the CLR wrapper's
         # surface, binding/C#/MultiversoCLR/MultiversoCLR.h:11-45). No
