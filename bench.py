@@ -126,7 +126,13 @@ def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
 
 def run_ps(corpus: str, prebuilt=None) -> dict:
     """Same workload through the parameter-server path (row-sparse
-    pulls, compact step, delta pushes, pipelined)."""
+    pulls, compact step, delta pushes, pipelined).
+
+    Single worker by design: N virtual ranks on ONE device measure
+    contention, not scaling (each reference worker owns its hardware);
+    multi-worker correctness is gated by tests/test_wordembedding.py and
+    tests/test_net_integration.py, multi-chip sharding by
+    __graft_entry__.dryrun_multichip."""
     import multiverso_tpu as mv
     from multiverso_tpu.models.wordembedding import (BlockLoader,
                                                      PSWord2Vec,
