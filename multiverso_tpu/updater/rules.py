@@ -27,10 +27,14 @@ Formulas (and deviations):
   AdaGrad semantics its structure describes (per-worker historic squared
   gradients, lr-normalized delta, rho-scaled step).
 
+- dcasgd: delay-compensated ASGD — see DCASGDRule (the reference ships
+  this updater permanently disabled; here it works).
+
 Duplicate row indices within one row-sparse Add compound correctly for
-default/sgd (scatter-add); for momentum/adagrad the state update applies
-once per unique row (the reference's sequential loop compounds instead —
-callers there dedupe rows per block, e.g. WordEmbedding's DataBlock).
+default/sgd (scatter-add); for momentum/adagrad/dcasgd the state update
+applies once per unique row (the reference's sequential loop compounds
+instead — callers there dedupe rows per block, e.g. WordEmbedding's
+DataBlock).
 """
 
 from __future__ import annotations
@@ -45,7 +49,8 @@ from ..util import log
 from ..util.configure import define_string, get_flag
 
 define_string("updater_type", "default",
-              "server updater: default / sgd / momentum / adagrad")
+              "server updater: default / sgd / momentum / adagrad / "
+              "dcasgd")
 
 ADAGRAD_EPS = 1e-6  # ref: adagrad_updater.h:18
 
@@ -132,8 +137,57 @@ class AdaGradRule(UpdaterRule):
         return data.at[row_ids].add(-step, mode="drop"), state
 
 
+class DCASGDRule(UpdaterRule):
+    """Delay-compensated ASGD (Zheng et al. 2017). The reference declares
+    this updater but ships it permanently disabled — the source file is
+    absent and the ENABLE_DCASGD macro is never defined
+    (ref: src/updater/updater.cpp:2-9,53-55, CMakeLists.txt:9); this is a
+    working implementation of the hook.
+
+    The server keeps a per-worker parameter backup; a delta arriving from
+    worker m (delta = lr * g, the sgd convention) is compensated for the
+    staleness it accumulated since that worker's last update:
+
+        w -= lr * (g + lambda * g * g * (w - backup[m]));  backup[m] = w
+
+    The backup starts at zero, so each worker's FIRST push compensates
+    against the origin — with the second-order term scaled by lambda this
+    is benign, and every later push uses the true snapshot."""
+
+    name = "dcasgd"
+
+    def init_state(self, shape, dtype, num_workers: int):
+        return jnp.zeros((num_workers,) + tuple(shape), dtype)
+
+    def dense(self, data, state, delta, hyp, worker_id):
+        lr, lam = hyp[1].astype(data.dtype), hyp[3].astype(data.dtype)
+        grad = delta / lr
+        comp = lam * grad * grad * (data - state[worker_id])
+        new = data - (delta + lr * comp)
+        return new, state.at[worker_id].set(new)
+
+    def rows(self, data, state, row_ids, delta, hyp, worker_id):
+        lr, lam = hyp[1].astype(data.dtype), hyp[3].astype(data.dtype)
+        grad = delta / lr
+        rows_now = data.at[row_ids].get(mode="fill", fill_value=0)
+        bak = state.at[worker_id, row_ids].get(mode="fill", fill_value=0)
+        step = delta + lr * lam * grad * grad * (rows_now - bak)
+        # Scatter-ADD the step so duplicate row ids compound their deltas
+        # (matching sgd; the compensation term is evaluated against the
+        # same pre-update rows for each duplicate, like momentum/adagrad's
+        # once-per-unique-row state). The backup records one step for a
+        # duplicated row — second-order staleness error, documented.
+        data = data.at[row_ids].add(-step, mode="drop")
+        state = state.at[worker_id, row_ids].set(rows_now - step,
+                                                 mode="drop")
+        return data, state
+
+
 _RULES = {cls.name: cls for cls in
-          (DefaultRule, SGDRule, MomentumRule, AdaGradRule)}
+          (DefaultRule, SGDRule, MomentumRule, AdaGradRule, DCASGDRule)}
+# The reference's flag value for the momentum updater is "momentum_sgd"
+# (ref: src/updater/updater.cpp:47-58); accept both spellings.
+_RULES["momentum_sgd"] = MomentumRule
 
 
 def create_rule(updater_type: Optional[str] = None,

@@ -139,3 +139,76 @@ class TestRowRules:
         assert bucket_size(8) == 8
         assert bucket_size(9) == 16
         assert bucket_size(1000) == 1024
+
+
+class TestDCASGD:
+    """Delay-compensated ASGD (the reference's permanently-disabled
+    updater hook, implemented for real — see DCASGDRule)."""
+
+    def test_dense_compensation(self):
+        eng = make_engine("dcasgd", (2,), num_workers=2)
+        lr, lam = 0.1, 0.04
+        opt = AddOption(worker_id=0, learning_rate=lr, lambda_=lam)
+        data = np.full(2, 1.0, np.float32)
+        delta = np.full(2, 0.05, np.float32)  # = lr * g, g = 0.5
+        g = 0.05 / lr
+        # First push: backup[0] is zeros -> compensation vs origin.
+        expect = 1.0 - (0.05 + lr * lam * g * g * (1.0 - 0.0))
+        data = eng.apply_dense(data, delta, opt)
+        np.testing.assert_allclose(np.asarray(data), np.full(2, expect),
+                                   rtol=1e-6)
+        # Second push from the SAME worker: backup == current params, so
+        # zero staleness -> plain sgd step.
+        prev = float(np.asarray(data)[0])
+        data = eng.apply_dense(data, delta, opt)
+        np.testing.assert_allclose(np.asarray(data),
+                                   np.full(2, prev - 0.05), rtol=1e-6)
+        # A push from worker 1 moves params; worker 0's NEXT push now
+        # sees nonzero staleness and compensates.
+        data = eng.apply_dense(data, delta,
+                               AddOption(worker_id=1, learning_rate=lr,
+                                         lambda_=lam))
+        w = float(np.asarray(data)[0])
+        bak0 = prev - 0.05  # worker 0's backup after its second push
+        expect = w - (0.05 + lr * lam * g * g * (w - bak0))
+        data = eng.apply_dense(data, delta, opt)
+        np.testing.assert_allclose(np.asarray(data), np.full(2, expect),
+                                   rtol=1e-6)
+
+    def test_rows_match_dense(self):
+        lr, lam = 0.2, 0.1
+        opt = AddOption(worker_id=0, learning_rate=lr, lambda_=lam)
+        dense_eng = make_engine("dcasgd", (4, 3), num_workers=1)
+        rows_eng = make_engine("dcasgd", (4, 3), num_workers=1)
+        data_d = np.arange(12, dtype=np.float32).reshape(4, 3)
+        data_r = data_d.copy()
+        full_delta = np.zeros((4, 3), np.float32)
+        rows = np.array([1, 3], np.int32)
+        full_delta[rows] = 0.06
+        data_d = dense_eng.apply_dense(data_d, full_delta, opt)
+        data_r = rows_eng.apply_rows(data_r, rows,
+                                     np.full((2, 3), 0.06, np.float32),
+                                     opt)
+        # Untouched rows see zero delta AND zero grad -> identical; the
+        # dense path also rewrites its backup for untouched rows, which
+        # only matters for later staleness, so compare the data only.
+        np.testing.assert_allclose(np.asarray(data_d), np.asarray(data_r),
+                                   rtol=1e-6)
+
+    def test_rows_duplicates_compound_like_sgd(self):
+        # Duplicate row ids in one Add must compound their deltas (the
+        # scatter-add semantics sgd has); the compensation is evaluated
+        # once against the pre-update rows.
+        lr = 0.1
+        opt = AddOption(worker_id=0, learning_rate=lr, lambda_=0.0)
+        eng = make_engine("dcasgd", (4, 2), num_workers=1)
+        data = np.ones((4, 2), np.float32)
+        rows = np.array([3, 3, 3], np.int32)
+        delta = np.full((3, 2), 0.05, np.float32)
+        data = eng.apply_rows(data, rows, delta, opt)
+        # lambda=0 -> pure sgd: three deltas land on row 3.
+        np.testing.assert_allclose(np.asarray(data)[3],
+                                   np.full(2, 1.0 - 3 * 0.05), rtol=1e-6)
+
+    def test_momentum_sgd_alias(self):
+        assert create_rule("momentum_sgd").name == "momentum"
