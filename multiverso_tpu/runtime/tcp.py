@@ -116,6 +116,10 @@ def _deserialize(body: bytes) -> Message:
 class TcpNet(NetInterface):
     """One endpoint of a full-mesh TCP cluster."""
 
+    #: Optional callback fired when a peer connection dies while the
+    #: mesh is still supposed to be up (set by Zoo.start -> Zoo.abort).
+    on_peer_lost = None
+
     def __init__(self, rank: int, endpoints: List[str],
                  default_port: Optional[int] = None):
         if not 0 <= rank < len(endpoints):
@@ -177,11 +181,20 @@ class TcpNet(NetInterface):
             self._listener.close()
         except OSError:
             pass
-        for sock in list(self._out.values()):
-            try:
-                sock.close()
-            except OSError:
-                pass
+        for dst, sock in list(self._out.items()):
+            # Goodbye frame (length 0): tells the peer's reader this
+            # close is GRACEFUL, so peer-death detection stays quiet.
+            # Take the per-destination send lock so the goodbye cannot
+            # interleave into a frame a sender is mid-writing.
+            with self._out_locks[dst]:
+                try:
+                    sock.sendall(_LEN.pack(0))
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
         self._out.clear()
         self._inbox.exit()
 
@@ -238,16 +251,21 @@ class TcpNet(NetInterface):
             self._readers.append(reader)
 
     def _reader_main(self, conn: socket.socket) -> None:
+        clean = False
         try:
             while not self._closed:
                 head = _read_exact(conn, _LEN.size)
                 if head is None:
                     return
                 (total,) = _LEN.unpack(head)
+                if total == 0:  # goodbye frame: graceful peer close
+                    clean = True
+                    return
                 body = _read_exact(conn, total)
                 if body is None:
                     return
                 self._inbox.push(_deserialize(body))
+            clean = True
         except OSError:
             return  # torn down mid-read
         finally:
@@ -255,6 +273,16 @@ class TcpNet(NetInterface):
                 conn.close()
             except OSError:
                 pass
+            if not clean and not self._closed:
+                # A peer hung up while the mesh is live: report it so the
+                # zoo can abort blocked waits (the reference has no such
+                # detection — a dead MPI rank hangs the cluster).
+                hook = self.on_peer_lost
+                if hook is not None:
+                    try:
+                        hook()
+                    except Exception:  # noqa: BLE001 - abort must not die
+                        pass
 
     # -- bootstrap --
     @classmethod

@@ -88,6 +88,12 @@ class Zoo:
         need a per-zoo override)."""
         remaining = parse_cmd_flags(argv)
         self._net = net if net is not None else self._resolve_net()
+        if hasattr(self._net, "on_peer_lost"):
+            # Failure detection (absent in the reference, SURVEY.md
+            # section 5.3): a TCP peer dying mid-run aborts this zoo so
+            # blocked barriers/registrations/table waits raise instead
+            # of hanging.
+            self._net.on_peer_lost = self.abort
         self._role_override = role
         if not get_flag("ma"):
             self._start_ps()
@@ -242,11 +248,16 @@ class Zoo:
 
     # -- abort: unblock every control wait after a peer failure --
     def abort(self) -> None:
-        """Mark this zoo dead and wake any thread blocked in barrier() or
-        registration. Used by LocalCluster when a sibling rank errors —
-        without it, mispaired barriers hang the whole cluster."""
+        """Mark this zoo dead and wake any thread blocked in barrier(),
+        registration, or a table wait. Used by LocalCluster when a
+        sibling rank errors and by the TCP transport when a peer
+        disconnects — without it, mispaired barriers and requests to the
+        dead rank hang forever."""
         self._aborted = True
         self.mailbox.push(_ABORT)
+        worker = self._actors.get(actors.WORKER)
+        if worker is not None:
+            worker.abort_tables(f"rank {self.rank}: cluster aborted")
 
     def _pop_control(self):
         reply = self.mailbox.pop()

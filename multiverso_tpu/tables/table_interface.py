@@ -76,6 +76,10 @@ class WorkerTable:
         return msg_id
 
     def _new_request(self) -> int:
+        # Requests issued AFTER an abort would wait on a reply that can
+        # never come (their waiter postdates abort()'s release sweep) —
+        # refuse up front.
+        self._check_aborted()
         with self._mutex:
             self._msg_id += 1
             msg_id = self._msg_id
@@ -85,15 +89,34 @@ class WorkerTable:
     # -- waiter plumbing, driven by the worker actor
     #    (ref: src/table.cpp:84-111) --
     def wait(self, msg_id: int, timeout: Optional[float] = None) -> bool:
+        self._check_aborted()
         with self._mutex:
             waiter = self._waitings.get(msg_id)
         if waiter is None:
             return True  # already completed
         ok = waiter.wait(timeout=timeout)
+        self._check_aborted()
         if ok:
             with self._mutex:
                 self._waitings.pop(msg_id, None)
         return ok
+
+    def _check_aborted(self) -> None:
+        reason = getattr(self, "_abort_reason", None)
+        if reason is not None:
+            from ..runtime.zoo import ClusterAborted
+            raise ClusterAborted(reason)
+
+    def abort(self, reason: str) -> None:
+        """Release every outstanding waiter; subsequent/blocked ``wait``
+        calls raise ClusterAborted (peer-failure path — without this a
+        request to a dead rank blocks forever; the reference has no
+        failure detection at all, SURVEY.md section 5.3)."""
+        self._abort_reason = reason
+        with self._mutex:
+            waiters = list(self._waitings.values())
+        for waiter in waiters:
+            waiter.release()
 
     def reset(self, msg_id: int, num_wait: int) -> None:
         with self._mutex:

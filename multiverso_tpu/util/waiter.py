@@ -35,6 +35,13 @@ class Waiter:
             if self._num_wait <= 0:
                 self._cond.notify_all()
 
+    def release(self) -> None:
+        """Force-complete: wake every waiter regardless of pending count
+        (abort path — the caller records why)."""
+        with self._cond:
+            self._num_wait = 0
+            self._cond.notify_all()
+
     @property
     def done(self) -> bool:
         with self._mutex:

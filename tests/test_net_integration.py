@@ -235,3 +235,51 @@ print("BINDCONNECT_OK")
 """
     outs = run_cluster([body] * 2)
     assert all("BINDCONNECT_OK" in o for o in outs)
+
+
+def test_peer_death_aborts_instead_of_hanging(tmp_path):
+    # Failure detection (absent in the reference — a dead MPI rank hangs
+    # the cluster, SURVEY.md section 5.3): when a peer process dies
+    # mid-run, survivors blocked in barrier() or a table wait must raise
+    # ClusterAborted instead of blocking forever.
+    mf, _ = write_machine_file(tmp_path, 2)
+    survivor = f"""
+import multiverso_tpu as mv
+from multiverso_tpu.runtime.zoo import ClusterAborted
+mv.init(["-machine_file={mf}", "-rank=" + str(rank)])
+table = mv.create_array_table(4)
+table.add(np.ones(4, np.float32))
+mv.barrier()  # both ranks alive here
+try:
+    mv.barrier()  # rank 1 dies instead of joining this one
+    print("BARRIER_RETURNED")
+except ClusterAborted:
+    print("ABORTED_OK")
+mv.shutdown(finalize_net=True)
+"""
+    dier = f"""
+import os
+import multiverso_tpu as mv
+mv.init(["-machine_file={mf}", "-rank=" + str(rank)])
+table = mv.create_array_table(4)
+table.add(np.ones(4, np.float32))
+mv.barrier()
+os._exit(1)  # crash without goodbye frames
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=REPO)
+    procs = [subprocess.Popen([sys.executable, "-c",
+                               PRELUDE.format(repo=REPO) + body],
+                              env=dict(env, MV_RANK=str(rank)),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for rank, body in enumerate([survivor, dier])]
+    try:
+        out0, err0 = procs[0].communicate(timeout=180)
+        procs[1].communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        out0, err0 = procs[0].communicate()
+    assert "ABORTED_OK" in out0, out0 + err0[-1000:]
