@@ -1,6 +1,6 @@
 from . import configure, log
 from .async_buffer import ASyncBuffer
-from .dashboard import Dashboard, Monitor, monitor
+from .dashboard import Dashboard, Monitor, monitor, trace_to
 from .mt_queue import MtQueue
 from .quantization import OneBitFilter, SparseFilter
 from .timer import Timer
@@ -9,4 +9,5 @@ from .waiter import Waiter
 __all__ = [
     "configure", "log", "ASyncBuffer", "Dashboard", "Monitor", "monitor",
     "MtQueue", "OneBitFilter", "SparseFilter", "Timer", "Waiter",
+    "trace_to",
 ]

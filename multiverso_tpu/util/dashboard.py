@@ -122,3 +122,18 @@ class monitor:
         if self._trace_ctx is not None:
             self._trace_ctx.__exit__(*exc)
         return None
+
+
+def trace_to(log_dir: str):
+    """Whole-program xprof capture: everything inside the block —
+    including ``monitor(..., trace=True)`` annotations — lands in a
+    TensorBoard-loadable trace under ``log_dir``. The TPU-native
+    counterpart of reading Dashboard.display() next to an MPI profile
+    (SURVEY.md section 5.1). Thin lazy-import alias of
+    ``jax.profiler.trace`` so future jax trace features are inherited.
+
+        with trace_to("/tmp/xprof"):
+            model.train_batches(loader)
+    """
+    import jax.profiler
+    return jax.profiler.trace(log_dir)

@@ -314,3 +314,27 @@ class TestPSWord2Vec:
             assert model.learning_rate() < lr0
         finally:
             mv.shutdown()
+
+
+class TestPreprocess:
+    def test_word_count_cli(self, tmp_path):
+        # ref: Applications/WordEmbedding/preprocess/word_count.cpp:30-46
+        # — count, filter by min_count + stopwords, save, reload.
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("a b c a b a\nthe the the a b\n")
+        (tmp_path / "sw.txt").write_text("the\n")
+        vocab = tmp_path / "v.txt"
+        from multiverso_tpu.models.wordembedding import preprocess
+        from multiverso_tpu.util.configure import reset_flags
+        reset_flags()
+        try:
+            d = preprocess.run([f"-train_file={corpus}",
+                                f"-save_vocab_file={vocab}",
+                                "-min_count=2",
+                                f"-sw_file={tmp_path / 'sw.txt'}"])
+        finally:
+            reset_flags()
+        assert d.size == 2 and "the" not in d.word2id
+        reloaded = Dictionary.load(str(vocab))
+        assert reloaded.word2id == d.word2id
+        assert list(reloaded.counts) == list(d.counts)
