@@ -43,6 +43,7 @@ BATCH = 32768
 DIM = 128
 NEG = 5
 PS_MAX_BATCHES = 240  # cap the timed PS segment (words/s is a rate)
+MIN_COUNT = 2  # 149K-word real dictionary on this corpus (reported below)
 
 # Nominal per-chip peaks for utilization reporting (dense matmul peak for
 # the compute dtype class; memory bandwidth). Conservative defaults.
@@ -77,7 +78,7 @@ def write_corpus(path: str) -> None:
 def _build(corpus: str):
     from multiverso_tpu.models.wordembedding import (Dictionary,
                                                      TokenizedCorpus)
-    dictionary = Dictionary.build(corpus, min_count=5)
+    dictionary = Dictionary.build(corpus, min_count=MIN_COUNT)
     tokenized = TokenizedCorpus.build(dictionary, corpus)
     return dictionary, tokenized
 
@@ -203,6 +204,7 @@ def cpu_baseline(corpus: str) -> dict:
         f"bench.VOCAB={VOCAB}; bench.SENTENCES={SENTENCES}\n"
         f"bench.EPOCHS={EPOCHS}; bench.BATCH={BATCH}\n"
         f"bench.DIM={DIM}; bench.NEG={NEG}\n"
+        f"bench.MIN_COUNT={MIN_COUNT}\n"
         # One epoch: words/s is a rate and loss parity compares the
         # fixed-seed FIRST epoch; 3 CPU epochs would triple bench time.
         f"r = bench.run_local({corpus!r}, epochs=1,"
@@ -388,7 +390,10 @@ def main() -> None:
             else None,
             "matrix_table_bandwidth": matrix,
             "phase_seconds": dict(_phase.seconds),
-            "setup": {"vocab_raw": VOCAB, "sentences": SENTENCES,
+            "setup": {"vocab_raw": VOCAB,
+                      "vocab_actual": local["dictionary"].size,
+                      "min_count": MIN_COUNT,
+                      "sentences": SENTENCES,
                       "epochs": EPOCHS, "batch": BATCH, "dim": DIM,
                       "negative": NEG,
                       "ps_batches": PS_MAX_BATCHES,
