@@ -90,6 +90,16 @@ def net_bind(rank: int, endpoint: str) -> None:
     _net_bind(rank, endpoint)
 
 
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, argv=None, control_port=None):
+    """Multi-host bootstrap: jax.distributed (data plane) + the TCP
+    control mesh rendezvoused through its coordinator + init. See
+    runtime/bootstrap.py."""
+    from .runtime.bootstrap import init_distributed as _impl
+    return _impl(coordinator_address, num_processes, process_id,
+                 argv, control_port)
+
+
 def net_connect(ranks, endpoints) -> None:
     """MV_NetConnect (ref: include/multiverso/multiverso.h:60-64): supply
     peer endpoints and build the TCP mesh consumed by the next ``init``."""

@@ -1,0 +1,118 @@
+"""Multi-host bootstrap: one call wires both planes.
+
+On a TPU pod each host runs one process; two meshes must come up:
+
+- the DATA plane — ``jax.distributed.initialize`` so XLA sees every
+  host's chips and collectives ride ICI/DCN inside jitted steps;
+- the CONTROL plane — this framework's TCP message mesh (registration,
+  barriers, table RPC), which needs every process's endpoint.
+
+The reference leaves placement to mpirun/machine files
+(ref: include/multiverso/net/zmq_net.h:20-28). Here the coordinator
+service jax.distributed already runs doubles as the rendezvous: each
+process publishes its control endpoint in the coordinator's key-value
+store and reads everyone else's — no machine file, no second launcher.
+
+    import multiverso_tpu as mv
+    mv.init_distributed(coordinator_address="host0:9777",
+                        num_processes=16, process_id=rank)
+    ...                      # tables, barriers, jitted steps
+    mv.shutdown()
+
+With ``num_processes == 1`` (coordinator still required — jax's
+cluster auto-detection only fills the arguments inside managed
+environments) the call degenerates to the single-process worker+server
+mode after initializing jax.distributed, so one launch script scales
+from a single host to a pod by changing its arguments.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional
+
+from ..util import log
+from ..util.net_util import free_listen_port
+from .tcp import net_bind, net_connect
+
+_KEY_PREFIX = "multiverso_tpu/control_endpoint/"
+
+
+def _reachable_address() -> str:
+    """This host's outbound-interface address (the UDP-connect trick —
+    gethostbyname(hostname) resolves to 127.0.1.1 on stock Debian hosts,
+    which would publish an unreachable endpoint to the pod)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _coordinator_client():
+    """The process-level coordination-service client jax.distributed
+    keeps after initialize(); exposed only via the internal state object,
+    so probe defensively and fail with a clear message."""
+    try:
+        from jax._src.distributed import global_state
+        client = getattr(global_state, "client", None)
+    except Exception:  # noqa: BLE001 - jax internals moved
+        client = None
+    if client is None:
+        raise RuntimeError(
+            "jax.distributed has no coordination client; pass a "
+            "-machine_file or use net_bind/net_connect for the control "
+            "mesh instead")
+    return client
+
+
+def exchange_endpoints(process_id: int, num_processes: int,
+                      my_endpoint: str,
+                      timeout_ms: int = 120_000) -> List[str]:
+    """All-gather of control endpoints through the jax.distributed
+    coordinator's key-value store."""
+    client = _coordinator_client()
+    client.key_value_set(f"{_KEY_PREFIX}{process_id}", my_endpoint)
+    return [client.blocking_key_value_get(f"{_KEY_PREFIX}{i}", timeout_ms)
+            for i in range(num_processes)]
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     argv: Optional[List[str]] = None,
+                     control_port: Optional[int] = None) -> List[str]:
+    """Initialize jax.distributed (data plane), rendezvous the TCP
+    control mesh through its coordinator, and mv.init. Arguments default
+    to jax's own cluster-environment auto-detection (TPU pods fill them
+    from the runtime). Returns the argv remainder from mv.init."""
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    num_processes = jax.process_count()
+    process_id = jax.process_index()
+    from .. import init as mv_init
+
+    if num_processes <= 1:
+        # Single process: worker+server degenerate mode, no TCP needed.
+        return mv_init(list(argv or []))
+
+    addr = _reachable_address()
+    # free_listen_port stays below the OS ephemeral range, so the port
+    # cannot be stolen by a peer's outbound connection between the
+    # rendezvous below and TcpNet's listener bind.
+    port = control_port if control_port is not None \
+        else free_listen_port(addr)
+    my_endpoint = f"{addr}:{port}"
+    endpoints = exchange_endpoints(process_id, num_processes, my_endpoint)
+    log.info("control mesh (%d processes): %s", num_processes, endpoints)
+    net_bind(process_id, my_endpoint)
+    net_connect(list(range(num_processes)), endpoints)
+    return mv_init(list(argv or []))

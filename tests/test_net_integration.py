@@ -283,3 +283,26 @@ os._exit(1)  # crash without goodbye frames
             p.kill()
         out0, err0 = procs[0].communicate()
     assert "ABORTED_OK" in out0, out0 + err0[-1000:]
+
+
+def test_init_distributed_two_processes(tmp_path):
+    # Multi-host bootstrap: jax.distributed.initialize gives the data
+    # plane; the TCP control mesh rendezvouses through its coordinator's
+    # key-value store — no machine file (runtime/bootstrap.py).
+    from multiverso_tpu.util.net_util import free_listen_port
+    coord = f"127.0.0.1:{free_listen_port()}"
+    body = f"""
+import multiverso_tpu as mv
+mv.init_distributed(coordinator_address={coord!r}, num_processes=2,
+                    process_id=rank)
+table = mv.create_array_table(6)
+table.add(np.full(6, float(rank + 1), np.float32))
+mv.barrier()
+out = table.get()
+mv.barrier()
+assert np.allclose(out, 3.0), out  # 1 + 2 from both processes
+mv.shutdown()
+print("DISTRIBUTED_BOOTSTRAP_OK")
+"""
+    outs = run_cluster([body, body])
+    assert all("DISTRIBUTED_BOOTSTRAP_OK" in o for o in outs), outs
