@@ -32,22 +32,18 @@ import socket
 from typing import List, Optional
 
 from ..util import log
-from ..util.net_util import free_listen_port
+from ..util.net_util import outbound_address, reserve_listen_port
 from .tcp import net_bind, net_connect
 
 _KEY_PREFIX = "multiverso_tpu/control_endpoint/"
 
 
 def _reachable_address() -> str:
-    """This host's outbound-interface address (the UDP-connect trick —
-    gethostbyname(hostname) resolves to 127.0.1.1 on stock Debian hosts,
-    which would publish an unreachable endpoint to the pod)."""
-    try:
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            s.connect(("10.255.255.255", 1))
-            return s.getsockname()[0]
-    except OSError:
-        pass
+    """Outbound-interface address (see net_util.outbound_address), with
+    hostname/loopback fallbacks for isolated hosts."""
+    addr = outbound_address()
+    if addr is not None:
+        return addr
     try:
         return socket.gethostbyname(socket.gethostname())
     except OSError:
@@ -93,9 +89,16 @@ def init_distributed(coordinator_address: Optional[str] = None,
     from the runtime). Returns the argv remainder from mv.init."""
     import jax
 
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    already_up = False
+    try:
+        from jax._src.distributed import global_state
+        already_up = getattr(global_state, "client", None) is not None
+    except Exception:  # noqa: BLE001 - jax internals moved
+        pass
+    if not already_up:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
     num_processes = jax.process_count()
     process_id = jax.process_index()
     from .. import init as mv_init
@@ -105,14 +108,22 @@ def init_distributed(coordinator_address: Optional[str] = None,
         return mv_init(list(argv or []))
 
     addr = _reachable_address()
-    # free_listen_port stays below the OS ephemeral range, so the port
-    # cannot be stolen by a peer's outbound connection between the
-    # rendezvous below and TcpNet's listener bind.
-    port = control_port if control_port is not None \
-        else free_listen_port(addr)
+    # Hold the bound reservation socket through the (possibly slow)
+    # rendezvous so a sibling process on this host cannot be handed the
+    # same port; release it just before TcpNet's listener bind.
+    reserved = None
+    if control_port is not None:
+        port = control_port
+    else:
+        reserved, port = reserve_listen_port(addr)
     my_endpoint = f"{addr}:{port}"
     endpoints = exchange_endpoints(process_id, num_processes, my_endpoint)
     log.info("control mesh (%d processes): %s", num_processes, endpoints)
     net_bind(process_id, my_endpoint)
+    if reserved is not None:
+        # Release the reservation only now: net_connect constructs the
+        # TCP endpoint (binding the listener) immediately, so the unsafe
+        # window is microseconds rather than the whole rendezvous.
+        reserved.close()
     net_connect(list(range(num_processes)), endpoints)
     return mv_init(list(argv or []))

@@ -17,10 +17,15 @@ from typing import Deque, List
 
 from ..core.message import Message, MsgType
 from ..util import log
-from ..util.configure import get_flag
+from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from .actor import Actor
+
+define_double("backup_worker_ratio", 0.0,
+              "reserved: fraction of workers treated as backups by the "
+              "sync server (defined-but-unused in the reference too, "
+              "ref: src/server.cpp:21 — kept for flag-surface parity)")
 
 _INF = float("inf")
 

@@ -10,7 +10,20 @@ standard library only.
 from __future__ import annotations
 
 import socket
-from typing import Set
+from typing import Optional, Set, Tuple
+
+
+def outbound_address() -> Optional[str]:
+    """This host's outbound-interface address via the UDP-connect trick
+    (the OS picks the interface without sending a packet); None when no
+    route exists. Preferred over gethostbyname(hostname), which resolves
+    to 127.0.1.1 on stock Debian hosts."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return None
 
 
 def local_addresses() -> Set[str]:
@@ -23,14 +36,9 @@ def local_addresses() -> Set[str]:
             addrs.add(info[4][0])
     except OSError:
         pass
-    try:
-        # UDP connect trick: the OS picks the outbound interface address
-        # without sending a packet.
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            s.connect(("10.255.255.255", 1))
-            addrs.add(s.getsockname()[0])
-    except OSError:
-        pass
+    out = outbound_address()
+    if out is not None:
+        addrs.add(out)
     return addrs
 
 
@@ -62,3 +70,24 @@ def free_listen_port(host: str = "127.0.0.1") -> int:
             except OSError:
                 continue
             return port
+
+
+def reserve_listen_port(host: str = "127.0.0.1") -> Tuple[socket.socket, int]:
+    """Like ``free_listen_port`` but returns the BOUND socket so the
+    caller can hold the reservation across a slow rendezvous and close
+    it immediately before the real listener binds — without the hold,
+    two same-host processes scanning from the same pid-seeded slot can
+    be handed one port."""
+    global _next_listen_port
+    while True:
+        port = _next_listen_port
+        _next_listen_port += 1
+        if _next_listen_port >= 32700:
+            _next_listen_port = 21000
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.bind((host, port))
+        except OSError:
+            sock.close()
+            continue
+        return sock, port
