@@ -115,15 +115,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if control_port is not None:
         port = control_port
     else:
-        reserved, port = reserve_listen_port(addr)
-    my_endpoint = f"{addr}:{port}"
-    endpoints = exchange_endpoints(process_id, num_processes, my_endpoint)
-    log.info("control mesh (%d processes): %s", num_processes, endpoints)
-    net_bind(process_id, my_endpoint)
-    if reserved is not None:
-        # Release the reservation only now: net_connect constructs the
-        # TCP endpoint (binding the listener) immediately, so the unsafe
-        # window is microseconds rather than the whole rendezvous.
-        reserved.close()
+        reserved, port = reserve_listen_port()
+    try:
+        my_endpoint = f"{addr}:{port}"
+        endpoints = exchange_endpoints(process_id, num_processes,
+                                       my_endpoint)
+        log.info("control mesh (%d processes): %s", num_processes,
+                 endpoints)
+        net_bind(process_id, my_endpoint)
+    finally:
+        if reserved is not None:
+            # Release the reservation only now: net_connect constructs
+            # the TCP endpoint (binding the listener) immediately, so
+            # the unsafe window is microseconds, not the rendezvous.
+            reserved.close()
     net_connect(list(range(num_processes)), endpoints)
     return mv_init(list(argv or []))

@@ -58,26 +58,21 @@ def free_listen_port(host: str = "127.0.0.1") -> int:
     listener bind by a peer's outbound connection, whose OS-assigned
     source port comes from that same ephemeral range; handing processes
     listen ports outside it removes the race."""
-    global _next_listen_port
-    while True:
-        port = _next_listen_port
-        _next_listen_port += 1
-        if _next_listen_port >= 32700:
-            _next_listen_port = 21000
-        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-            try:
-                s.bind((host, port))
-            except OSError:
-                continue
-            return port
+    sock, port = reserve_listen_port(host)
+    sock.close()
+    return port
 
 
 def reserve_listen_port(host: str = "127.0.0.1") -> Tuple[socket.socket, int]:
-    """Like ``free_listen_port`` but returns the BOUND socket so the
-    caller can hold the reservation across a slow rendezvous and close
-    it immediately before the real listener binds — without the hold,
-    two same-host processes scanning from the same pid-seeded slot can
-    be handed one port."""
+    """A scan-range port returned WITH its bound socket, so the caller
+    can hold the reservation across a slow rendezvous and close it right
+    before the real listener binds — without the hold, two same-host
+    processes scanning from the same pid-seeded slot can be handed one
+    port. The reservation binds the WILDCARD address regardless of
+    ``host``: listeners bind wildcard too, and an addr-specific
+    reservation would not block a sibling's 127.0.0.1 probe of the same
+    port."""
+    del host  # wildcard-only: see docstring
     global _next_listen_port
     while True:
         port = _next_listen_port
@@ -86,7 +81,7 @@ def reserve_listen_port(host: str = "127.0.0.1") -> Tuple[socket.socket, int]:
             _next_listen_port = 21000
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
-            sock.bind((host, port))
+            sock.bind(("", port))
         except OSError:
             sock.close()
             continue
