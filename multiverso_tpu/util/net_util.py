@@ -52,27 +52,26 @@ def free_port(host: str = "127.0.0.1") -> int:
 _next_listen_port = 21000 + (__import__("os").getpid() % 400) * 20
 
 
-def free_listen_port(host: str = "127.0.0.1") -> int:
+def free_listen_port() -> int:
     """A free port *below* the OS ephemeral range (Linux default
     32768-60999). Ports from ``free_port`` can be stolen between probe and
     listener bind by a peer's outbound connection, whose OS-assigned
     source port comes from that same ephemeral range; handing processes
-    listen ports outside it removes the race."""
-    sock, port = reserve_listen_port(host)
+    listen ports outside it removes the race. Probes the wildcard
+    address (listeners bind wildcard)."""
+    sock, port = reserve_listen_port()
     sock.close()
     return port
 
 
-def reserve_listen_port(host: str = "127.0.0.1") -> Tuple[socket.socket, int]:
+def reserve_listen_port() -> Tuple[socket.socket, int]:
     """A scan-range port returned WITH its bound socket, so the caller
     can hold the reservation across a slow rendezvous and close it right
     before the real listener binds — without the hold, two same-host
     processes scanning from the same pid-seeded slot can be handed one
-    port. The reservation binds the WILDCARD address regardless of
-    ``host``: listeners bind wildcard too, and an addr-specific
-    reservation would not block a sibling's 127.0.0.1 probe of the same
-    port."""
-    del host  # wildcard-only: see docstring
+    port. Binds the WILDCARD address: listeners bind wildcard too, and
+    an addr-specific reservation would not block a sibling's loopback
+    probe of the same port."""
     global _next_listen_port
     while True:
         port = _next_listen_port
