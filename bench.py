@@ -86,6 +86,35 @@ def _build(corpus: str):
     return dictionary, tokenized
 
 
+def _timed_batches(gen, walls, words, sync_every=0, sync_fn=None):
+    """Record per-batch (or per-window) walls + word counts around a
+    batch stream. With ``sync_every``/``sync_fn`` set, batches are
+    AGGREGATED into device-synced windows — a fully-async loop's
+    per-batch intervals measure host dispatch cadence (overstating the
+    rate by orders of magnitude), so each recorded sample must span a
+    sync. One entry lands in ``walls``/``words`` per window."""
+    last = time.perf_counter()
+    acc_words = 0.0
+    pending = 0
+    for batch in gen:
+        yield batch
+        if sync_every and sync_fn is not None:
+            acc_words += batch.words
+            pending += 1
+            if pending == sync_every:
+                sync_fn()
+                now = time.perf_counter()
+                walls.append(now - last)
+                words.append(acc_words)
+                acc_words, pending = 0.0, 0
+                last = now
+        else:
+            now = time.perf_counter()
+            walls.append(now - last)
+            words.append(batch.words)
+            last = now
+
+
 def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
               schedule_epochs: int = None) -> dict:
     """Train ``epochs`` epochs. ``schedule_epochs`` (default = epochs)
@@ -108,19 +137,35 @@ def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
     warm_words = model.trained_words
     epoch_losses = []
     pair_total = 0
+    batch_walls = []
+    batch_words = []
+
+    def sync():
+        import jax
+        jax.block_until_ready(model._emb_in)
+
     start = time.perf_counter()
     for epoch in range(epochs):
         # Row prep runs in the loader thread, overlapped with device
-        # steps (model.prepared); the loop only dispatches.
-        loss_sum, pairs = model.train_batches(BlockLoader(model.prepared(
-            iter_pair_batches(dictionary, tokenized, batch_size=BATCH,
-                              window=5, subsample=1e-3, seed=epoch))))
+        # steps (model.prepared); the loop only dispatches — so the
+        # median timer syncs every 16 batches or it would measure
+        # dispatch cadence, not throughput.
+        loss_sum, pairs = model.train_batches(_timed_batches(
+            BlockLoader(model.prepared(iter_pair_batches(
+                dictionary, tokenized, batch_size=BATCH,
+                window=5, subsample=1e-3, seed=epoch))),
+            batch_walls, batch_words, sync_every=16, sync_fn=sync))
         epoch_losses.append(loss_sum / max(pairs, 1))
         pair_total += pairs
     elapsed = time.perf_counter() - start
     assert all(np.isfinite(x) for x in epoch_losses), epoch_losses
+    # Same mean-words-over-median-wall approximation as run_ps: robust
+    # to transient transport stalls the wall average folds in.
+    med = float(np.median(batch_walls)) if batch_walls else 0.0
     return {
         "wps": (model.trained_words - warm_words) / elapsed,
+        "median_batch_wps": round(
+            float(np.mean(batch_words)) / med, 0) if med else 0.0,
         "pairs_per_sec": pair_total / elapsed,
         "epoch_losses": [round(float(x), 4) for x in epoch_losses],
         "model": model,
@@ -168,19 +213,10 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
     warm_words = model.trained_words
     batch_walls = []
     batch_words = []
-
-    def timed_batches(gen):
-        last = time.perf_counter()
-        for batch in gen:
-            yield batch
-            now = time.perf_counter()
-            batch_walls.append(now - last)
-            batch_words.append(batch.words)
-            last = now
-
     start = time.perf_counter()
-    loss_sum, pairs = model.train_batches(timed_batches(
-        BlockLoader(model.prepared(capped(0)))))
+    loss_sum, pairs = model.train_batches(_timed_batches(
+        BlockLoader(model.prepared(capped(0))),
+        batch_walls, batch_words))
     elapsed = time.perf_counter() - start
     words = model.trained_words - warm_words
     # Median per-batch rate: robust to transient transport stalls that
@@ -405,6 +441,7 @@ def main() -> None:
         "unit": "words/s",
         "vs_baseline": round(local["wps"] / cpu["wps"], 3) if cpu else None,
         "detail": {
+            "local_median_batch_words_per_sec": local["median_batch_wps"],
             "ps_words_per_sec": round(ps["wps"], 0),
             "ps_median_batch_words_per_sec": ps["median_batch_wps"],
             "ps_vs_local": round(ps["wps"] / local["wps"], 3),
