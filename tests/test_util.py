@@ -242,3 +242,19 @@ class TestCheck:
         with pytest.raises(FatalError):
             CHECK(False, "boom")
         CHECK(True)
+
+
+class TestTraceTo:
+    def test_trace_capture_writes_xplane(self, tmp_path):
+        # Whole-program xprof capture (the TPU-side tracing complement
+        # to the Dashboard counters, SURVEY.md section 5.1).
+        import glob
+
+        import jax.numpy as jnp
+
+        from multiverso_tpu.util import monitor, trace_to
+        with trace_to(str(tmp_path)):
+            with monitor("TRACE_REGION", trace=True):
+                jnp.ones((32, 32)) @ jnp.ones((32, 32))
+        files = glob.glob(str(tmp_path) + "/**/*", recursive=True)
+        assert any("xplane" in f or "trace" in f for f in files), files
