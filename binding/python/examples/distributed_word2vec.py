@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Runnable multi-process distributed training example.
+
+Launches N OS processes over localhost TCP (machine-file bootstrap, the
+reference's ZMQ deployment mode — ref: include/multiverso/net/
+zmq_net.h:20-61) and trains word2vec through the parameter server: each
+worker reads its own shard of the corpus, pulls embedding rows, trains,
+and pushes deltas; BSP or async per the ``--sync`` flag. Rank 0 saves
+the embeddings and verifies they learned the corpus's two-topic
+structure. (The reference ships the same story as theano/lasagne
+multi-process examples — ref: binding/python/examples/theano/.)
+
+    python binding/python/examples/distributed_word2vec.py            # 2 procs
+    python binding/python/examples/distributed_word2vec.py -n 4 --sync
+
+Runs on any machine — no TPU needed (children force the CPU backend);
+on a TPU host the same script uses the chip. Wired into ci.sh as the
+distributed-example gate.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+sys.path.insert(0, REPO)
+
+
+def make_corpus(path: str, sentences: int = 600, seed: int = 0) -> None:
+    """Two disjoint topic vocabularies; words co-occur only within
+    their topic — so trained embeddings must cluster by topic."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    topics = [[f"a{i}" for i in range(8)], [f"b{i}" for i in range(8)]]
+    with open(path, "w") as f:
+        for _ in range(sentences):
+            topic = topics[rng.integers(0, 2)]
+            f.write(" ".join(rng.choice(topic, size=12)) + "\n")
+
+
+def worker(rank: int) -> None:
+    """One training process: machine-file TCP mesh + PS word2vec on
+    this rank's corpus shard."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # example runs anywhere
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding import (BlockLoader,
+                                                     Dictionary,
+                                                     PSWord2Vec,
+                                                     Word2VecConfig,
+                                                     iter_pair_batches)
+
+    argv = [f"-machine_file={os.environ['MV_MACHINE_FILE']}",
+            f"-rank={rank}"]
+    if os.environ.get("MV_SYNC") == "1":
+        argv.append("-sync=true")
+    mv.init(argv)
+    corpus = os.environ["MV_CORPUS"]
+    # Shared dictionary (every rank builds it from the full corpus, as
+    # the reference's workers all load the same vocab file).
+    dictionary = Dictionary.build(corpus, min_count=1)
+    config = Word2VecConfig(embedding_size=16, window=3, epochs=2,
+                            init_learning_rate=0.02, batch_size=512,
+                            sample=0, use_ps=True)
+    model = PSWord2Vec(config, dictionary)
+    shard = f"{corpus}.shard{rank}"
+    for epoch in range(config.epochs):
+        loss, pairs = model.train_batches(BlockLoader(model.prepared(
+            iter_pair_batches(dictionary, shard, batch_size=512,
+                              window=3, subsample=0, seed=epoch))))
+        print(f"rank {rank} epoch {epoch}: "
+              f"loss/pair {loss / max(pairs, 1):.4f}", flush=True)
+    mv.barrier()
+    if rank == 0:
+        emb = model.embeddings
+        emb = emb / np.maximum(
+            np.linalg.norm(emb, axis=1, keepdims=True), 1e-9)
+        ids_a = [dictionary.word2id[w] for w in dictionary.words
+                 if w.startswith("a")]
+        ids_b = [dictionary.word2id[w] for w in dictionary.words
+                 if w.startswith("b")]
+        sims = emb @ emb.T
+        within = (sims[np.ix_(ids_a, ids_a)].mean()
+                  + sims[np.ix_(ids_b, ids_b)].mean()) / 2
+        across = sims[np.ix_(ids_a, ids_b)].mean()
+        sep = float(within - across)
+        model.save_embeddings(os.environ["MV_OUTPUT"])
+        print(f"rank 0: topic separation {sep:.3f} "
+              f"(embeddings -> {os.environ['MV_OUTPUT']})", flush=True)
+        assert sep > 0.2, f"embeddings failed to learn topics: {sep}"
+    mv.shutdown()
+
+
+def launch(n: int, sync: bool) -> int:
+    from multiverso_tpu.util.net_util import free_listen_port
+    tmp = tempfile.mkdtemp(prefix="mv_dist_example_")
+    corpus = os.path.join(tmp, "corpus.txt")
+    make_corpus(corpus)
+    # Shard the corpus round-robin, one shard file per worker (the
+    # reference splits input by rank the same way).
+    with open(corpus) as f:
+        lines = f.readlines()
+    for rank in range(n):
+        with open(f"{corpus}.shard{rank}", "w") as f:
+            f.writelines(lines[rank::n])
+    machine_file = os.path.join(tmp, "machines")
+    with open(machine_file, "w") as f:
+        for _ in range(n):
+            f.write(f"127.0.0.1:{free_listen_port()}\n")
+    env = dict(os.environ,
+               MV_MACHINE_FILE=machine_file,
+               MV_CORPUS=corpus,
+               MV_OUTPUT=os.path.join(tmp, "vectors.txt"),
+               MV_SYNC="1" if sync else "0",
+               PYTHONPATH=REPO)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--rank", str(rank)],
+        env=env) for rank in range(n)]
+    rc = 0
+    for rank, p in enumerate(procs):
+        try:
+            p.wait(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            print(f"rank {rank} timed out", file=sys.stderr)
+            rc = 1
+        rc = rc or p.returncode
+    print("distributed example:", "OK" if rc == 0 else "FAILED")
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--processes", type=int, default=2)
+    ap.add_argument("--sync", action="store_true",
+                    help="BSP mode (-sync=true) instead of async")
+    ap.add_argument("--rank", type=int, default=None,
+                    help=argparse.SUPPRESS)  # internal: worker role
+    args = ap.parse_args()
+    if args.rank is not None:
+        worker(args.rank)
+        return 0
+    return launch(args.processes, args.sync)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

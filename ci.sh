@@ -19,6 +19,14 @@ python -m pytest tests/test_net_integration.py -x -q
 echo "== c_api ABI through ctypes (+ Lua when a runtime exists) =="
 python -m pytest tests/test_binding.py -x -q
 
+echo "== runnable distributed example (2 OS processes, machine file) =="
+python binding/python/examples/distributed_word2vec.py -n 2
+
+echo "== CPU perf baseline builds and runs =="
+g++ -O3 -fopenmp -o /tmp/w2v_baseline_ci native/baseline/word2vec_baseline.cpp
+printf 'a b c d\nb a d c\n' > /tmp/w2v_ci_corpus.txt
+/tmp/w2v_baseline_ci /tmp/w2v_ci_corpus.txt - 1 8 2 2 0 0.025 1
+
 echo "== driver entry points =="
 python -c "import __graft_entry__ as g; fn, a = g.entry(); fn(*a)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -20,17 +20,64 @@ equivalent opt-in).
 from __future__ import annotations
 
 import io as _io
+import os
 import urllib.request
-from typing import Optional
+from typing import Callable, Dict, Optional, Union
 
 from .stream import Stream, StreamFactory
 
 _CHUNK = 1 << 20
 
+# -- authentication hook (the reference's hdfs backend was an
+# authenticated store, ref: include/multiverso/io/hdfs_stream.h:10-60;
+# real GCS/S3 interop endpoints need credential headers too).
+# Either a static header dict or a callable uri -> headers (for signed
+# URLs / refreshing tokens). The MV_HTTP_AUTH_TOKEN env var provides a
+# zero-code Bearer default.
+_auth: Optional[Union[Dict[str, str],
+                      Callable[[str], Dict[str, str]]]] = None
+
+
+def set_auth(auth: Optional[Union[Dict[str, str],
+                                  Callable[[str], Dict[str, str]]]]
+             ) -> None:
+    """Install auth headers for all http(s) streams: a header dict, a
+    ``uri -> headers`` callable, or None to clear."""
+    global _auth
+    _auth = auth
+
+
+def _auth_headers(uri: str) -> Dict[str, str]:
+    if callable(_auth):
+        return dict(_auth(uri))
+    headers = dict(_auth) if _auth else {}
+    token = os.environ.get("MV_HTTP_AUTH_TOKEN")
+    if token and "Authorization" not in headers:
+        # Scope the ambient token: only the host named by
+        # MV_HTTP_AUTH_HOST, or any https endpoint when unset — never
+        # cleartext http, where a bearer token would leak to whatever
+        # host (or redirect target) the uri points at. Cross-host or
+        # http use cases must opt in explicitly via set_auth.
+        from urllib.parse import urlsplit
+        parts = urlsplit(uri)
+        wanted = os.environ.get("MV_HTTP_AUTH_HOST")
+        if (parts.hostname == wanted if wanted
+                else parts.scheme == "https"):
+            headers["Authorization"] = f"Bearer {token}"
+    return headers
+
+
+def _request(uri: str, **kw) -> urllib.request.Request:
+    req = urllib.request.Request(uri, **kw)
+    for name, value in _auth_headers(uri).items():
+        req.add_header(name, value)
+    return req
+
 
 class _HttpReadStream(Stream):
     def __init__(self, uri: str):
-        self._resp = urllib.request.urlopen(uri)  # noqa: S310 - scheme-gated
+        self._resp = urllib.request.urlopen(  # noqa: S310 - scheme-gated
+            _request(uri))
         super().__init__(self._resp, uri)
         self._closed = False
 
@@ -71,7 +118,7 @@ class _HttpWriteStream(Stream):
             return
         self._closed = True
         payload = self._buf.getvalue()
-        req = urllib.request.Request(self._uri, data=payload, method="PUT")
+        req = _request(self._uri, data=payload, method="PUT")
         req.add_header("Content-Type", "application/octet-stream")
         with urllib.request.urlopen(req):  # noqa: S310 - scheme-gated
             pass

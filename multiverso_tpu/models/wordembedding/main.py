@@ -42,6 +42,10 @@ define_bool("hs", False, "hierarchical softmax instead of negative "
 define_bool("use_ps", False, "train through the parameter server")
 define_int("batch_size", 4096, "pairs per jitted step")
 define_bool("is_pipeline", True, "overlap loading with training")
+define_string("stopwords", "", "optional stopwords file (one word per "
+              "line) filtered out of the vocabulary — the reference "
+              "reader's stopwords table (ref: Applications/WordEmbedding"
+              "/src/reader.cpp, flag -stopwords)")
 
 
 def run(argv=None) -> Word2Vec:
@@ -57,11 +61,27 @@ def run(argv=None) -> Word2Vec:
     if not train_file:
         raise SystemExit("need -train_file=<corpus>")
 
+    stopwords = None
+    if get_flag("stopwords"):
+        from ...io import TextReader
+        stopwords = set()
+        reader = TextReader(get_flag("stopwords"))
+        while True:
+            line = reader.get_line()
+            if line is None:
+                break
+            word = line.strip()
+            if word:
+                stopwords.add(word)
+        reader.close()
+        log.info("loaded %d stopwords", len(stopwords))
+
     if get_flag("vocab_file"):
         dictionary = Dictionary.load(get_flag("vocab_file"))
     else:
         dictionary = Dictionary.build(train_file,
-                                      min_count=config.min_count)
+                                      min_count=config.min_count,
+                                      stopwords=stopwords)
     log.info("vocab: %d words, %d tokens", dictionary.size,
              dictionary.total_count)
 

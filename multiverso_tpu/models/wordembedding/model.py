@@ -59,7 +59,7 @@ class Word2VecConfig:
                  sample: float = 1e-3, init_learning_rate: float = 0.025,
                  cbow: bool = False, hs: bool = False,
                  batch_size: int = 4096, seed: int = 1,
-                 use_ps: bool = False):
+                 use_ps: bool = False, batch_group: int = 16):
         self.embedding_size = embedding_size
         self.window = window
         self.negative = negative
@@ -72,6 +72,10 @@ class Word2VecConfig:
         self.batch_size = batch_size
         self.seed = seed
         self.use_ps = use_ps
+        # Batches per device dispatch in train_batches (local mode): the
+        # K-step on-device loop that amortizes per-call dispatch latency.
+        # 1 disables grouping.
+        self.batch_group = batch_group
 
 
 def build_alias(probs: np.ndarray):
@@ -120,7 +124,10 @@ def _unique_rows_and_remap(ids_list, num_rows: int):
     for a in ids_list:
         mark[a.reshape(-1)] = True
     rows = np.flatnonzero(mark).astype(np.int32)
-    remap = np.empty(num_rows, np.int32)
+    # Absent ids map to slot 0 (zeros, not empty): CBOW/HS paths look up
+    # pad id 0 even when word 0 is not in the batch — the result is
+    # masked downstream, but it must still be deterministic memory.
+    remap = np.zeros(num_rows, np.int32)
     remap[rows] = np.arange(rows.size, dtype=np.int32)
     return rows, remap
 
@@ -177,6 +184,12 @@ class Word2Vec:
         self._rng = np.random.default_rng(config.seed + 13)
         self.trained_words = 0
         self.total_words = dictionary.total_count * config.epochs
+        self._multi_step = None  # built on first grouped dispatch
+        # Row-set pad minimums (see _pad_rows): the local path lets them
+        # float per batch; the PS path freezes them to one bucket per
+        # table so exactly ONE jit trace per gather/step/scatter exists.
+        self._pad_in_min = 8
+        self._pad_out_min = 8
         self._init_embeddings()
 
     def _init_output_structures(self) -> int:
@@ -201,12 +214,15 @@ class Word2Vec:
 
     def _init_embeddings(self) -> None:
         """Local mode: full device-resident matrices. ref init: uniform
-        (-0.5/dim, 0.5/dim) input, zeros output. The PS subclass
-        overrides this with table creation (no full local copies)."""
+        (-0.5/dim, 0.5/dim) input, zeros output. Initialized ON device
+        (jax.random) — a host-side init means uploading the whole V x D
+        table, ~0.5 GB at reference scale, over a possibly-slow
+        host->device link. The PS subclass overrides this with table
+        creation (no full local copies)."""
         vocab, dim = self.dictionary.size, self.config.embedding_size
-        rng = np.random.default_rng(self.config.seed)
-        self._emb_in = jnp.asarray(
-            (rng.random((vocab, dim)) - 0.5) / dim, jnp.float32)
+        init_key = jax.random.PRNGKey(self.config.seed ^ 0x5EED)
+        self._emb_in = (jax.random.uniform(init_key, (vocab, dim),
+                                           jnp.float32) - 0.5) / dim
         self._emb_out = jnp.zeros((self._out_rows, dim), jnp.float32)
         if self.config.hs:
             self._codes_dev = jnp.asarray(self._codes_host)
@@ -214,7 +230,12 @@ class Word2Vec:
         else:
             self._neg_prob_dev = jnp.asarray(self._neg_prob_host)
             self._neg_alias_dev = jnp.asarray(self._neg_alias_host)
+        # Per-batch PRNG keys derive as fold_in(base, batch_counter):
+        # the counter advances once per REAL batch, so the grouped scan
+        # (whose padded tail slots are masked no-ops) and the sequential
+        # path consume identical key streams — bit-identical training.
         self._key = jax.random.PRNGKey(self.config.seed)
+        self._batch_counter = 0
         self._step = self._build_step()
 
     # -- learning rate schedule --
@@ -271,7 +292,8 @@ class Word2Vec:
 
         return CompactBatch(
             rows_in=rows_in, rows_out=rows_out,
-            rows_in_p=_pad_rows(rows_in), rows_out_p=_pad_rows(rows_out),
+            rows_in_p=_pad_rows(rows_in, self._pad_in_min),
+            rows_out_p=_pad_rows(rows_out, self._pad_out_min),
             in_args=in_args, out_args=out_args,
             count=batch.count, words=batch.words, size=size)
 
@@ -333,21 +355,14 @@ class Word2Vec:
     # O(batch), not O(vocab). (Differentiating through the full V x D
     # tables rewrites both tables every step: ~GBs of traffic per batch
     # at 1M+ vocab, which is what capped round-1 scaling.)
-    def _build_step(self):
+    def _make_step_core(self):
+        """The per-batch update: gather -> grad -> scatter-add, taking an
+        already-split PRNG key. Shared by the single-step jit and the
+        grouped ``lax.scan`` multi-step."""
         config = self.config
         k = config.negative
 
-        def gather_input(emb_in, in_ids):
-            if config.cbow:
-                window = in_ids  # [B, 2W], -1 padded
-                mask = (window >= 0).astype(jnp.float32)
-                vecs = emb_in[jnp.maximum(window, 0)] * mask[..., None]
-                denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
-                return vecs, lambda g: g  # grads flow per window word
-            return emb_in[in_ids], None
-
-        def step(emb_in, emb_out, lr, key, pair_mask, in_ids, targets):
-            next_key, key = jax.random.split(key)
+        def core(emb_in, emb_out, lr, key, pair_mask, in_ids, targets):
             if config.hs:
                 points = self._points_dev[targets]  # [B, L]
                 codes = self._codes_dev[targets]
@@ -399,12 +414,55 @@ class Word2Vec:
                 loss_fn, argnums=(0, 1))(vecs, u)
             new_in = emb_in.at[in_gather].add(-lr * g_vecs)
             new_out = emb_out.at[out_ids].add(-lr * g_u)
-            # The next PRNG key comes back as a step OUTPUT: splitting on
-            # the host would be one more device call per batch, and each
-            # call pays the transport's dispatch latency.
-            return new_in, new_out, loss, next_key
+            return new_in, new_out, loss
+
+        return core
+
+    def _build_step(self):
+        core = self._make_step_core()
+
+        def step(emb_in, emb_out, lr, base_key, counter, pair_mask,
+                 in_ids, targets):
+            # The per-batch key folds in-jit (a host-side fold would be
+            # one more device call per batch, and each call pays the
+            # transport's dispatch latency).
+            key = jax.random.fold_in(base_key, counter)
+            return core(emb_in, emb_out, lr, key, pair_mask, in_ids,
+                        targets)
 
         return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_multi_step(self):
+        """K batches per dispatch: ``lax.scan`` of the step core over
+        stacked batch tensors. One host->device dispatch then drives K
+        sequential SGD steps entirely in HBM — each slot's key folds
+        from the SAME per-batch counter the sequential path uses (and
+        masked padding slots carry counter -1, consuming nothing), so
+        grouped and ungrouped training are bit-identical; only the
+        dispatch count changes. This is what amortizes the per-call
+        dispatch latency (~100ms on a tunneled device) that otherwise
+        bounds words/sec."""
+        core = self._make_step_core()
+
+        def multi(emb_in, emb_out, base_key, lrs, counts, counters,
+                  in_ids, targets):
+            def body(carry, xs):
+                emb_in, emb_out = carry
+                lr, count, counter, ii, tt = xs
+                key = jax.random.fold_in(base_key, counter)
+                # Mask from the scalar count (shipping [K, B] float masks
+                # would triple the per-group host->device transfer).
+                pm = (jnp.arange(tt.shape[0]) < count).astype(jnp.float32)
+                emb_in, emb_out, loss = core(emb_in, emb_out, lr, key,
+                                             pm, ii, tt)
+                return (emb_in, emb_out), loss
+
+            (emb_in, emb_out), losses = jax.lax.scan(
+                body, (emb_in, emb_out),
+                (lrs, counts, counters, in_ids, targets))
+            return emb_in, emb_out, losses.sum()
+
+        return jax.jit(multi, donate_argnums=(0, 1))
 
     def _pair_mask_for(self, count: int, size: int):
         if count == size:
@@ -422,9 +480,12 @@ class Word2Vec:
         else:
             in_ids, targets = batch.centers, batch.contexts
         size = batch.centers.shape[0]
-        self._emb_in, self._emb_out, loss, self._key = self._step(
+        counter = self._batch_counter
+        self._batch_counter += 1
+        self._emb_in, self._emb_out, loss = self._step(
             self._emb_in, self._emb_out,
             jnp.float32(self.learning_rate()), self._key,
+            np.int32(counter),
             self._pair_mask_for(batch.count, size),
             jnp.asarray(in_ids), jnp.asarray(targets))
         self.trained_words += batch.words
@@ -434,20 +495,76 @@ class Word2Vec:
         loss = self.train_batch_async(batch)
         return float(loss) / max(batch.count, 1)  # display per-pair loss
 
+    def _train_group(self, batches) -> object:
+        """Stack up to ``batch_group`` prepared batches and dispatch ONE
+        scanned device step over them. Short groups (the stream tail) pad
+        with count=0 slots — masked to zero loss and zero gradient — so
+        exactly one trace shape exists. Returns the group's device-scalar
+        loss sum."""
+        group = max(int(self.config.batch_group), 1)
+        first = batches[0]
+        cbow = isinstance(first, CbowBatch)
+        in_shape = first.window.shape if cbow else first.centers.shape
+        bsz = first.centers.shape[0]
+        in_ids = np.zeros((group,) + in_shape, np.int32)
+        targets = np.zeros((group, bsz), np.int32)
+        counts = np.zeros(group, np.int32)
+        counters = np.full(group, -1, np.int32)  # -1 = padded no-op slot
+        lrs = np.zeros(group, np.float32)
+        for i, b in enumerate(batches):
+            if cbow:
+                in_ids[i], targets[i] = b.window, b.centers
+            else:
+                in_ids[i], targets[i] = b.centers, b.contexts
+            counts[i] = b.count
+            counters[i] = self._batch_counter
+            self._batch_counter += 1
+            # Per-batch lr follows the word schedule exactly as the
+            # sequential path would have computed it.
+            lrs[i] = self.learning_rate()
+            self.trained_words += b.words
+        if self._multi_step is None:
+            self._multi_step = self._build_multi_step()
+        self._emb_in, self._emb_out, loss = self._multi_step(
+            self._emb_in, self._emb_out, self._key,
+            jnp.asarray(lrs), jnp.asarray(counts), jnp.asarray(counters),
+            jnp.asarray(in_ids), jnp.asarray(targets))
+        return loss
+
     def train_batches(self, iterator) -> Tuple[float, int]:
         """Drive a whole batch stream; returns (loss_sum, pair_count).
-        Device losses accumulate into ONE device scalar (a lazy ``+``
-        per batch) and materialize once at the end. Any per-batch host
-        read of a device scalar is a full round-trip — tens of ms over a
-        tunneled device — and so is each element of a deferred
-        ``jnp.stack``; the running add keeps exactly one buffer and one
-        final transfer."""
+
+        Batches dispatch in groups of ``batch_group`` through the scanned
+        multi-step — one host->device call per group (the reference's
+        block granularity, ref: distributed_wordembedding.cpp:147-236,
+        where a data block also carries many sentences per
+        request/train/push cycle). Device losses accumulate into ONE
+        device scalar (a lazy ``+`` per group) and materialize once at
+        the end. Any per-batch host read of a device scalar is a full
+        round-trip — tens of ms over a tunneled device — and so is each
+        element of a deferred ``jnp.stack``; the running add keeps
+        exactly one buffer and one final transfer."""
+        group = max(int(self.config.batch_group), 1)
         acc = None
         pairs = 0
+        if group == 1:
+            for batch in iterator:
+                loss = self.train_batch_async(batch)
+                acc = loss if acc is None else acc + loss
+                pairs += batch.count
+            return 0.0 if acc is None else float(acc), pairs
+        buf = []
         for batch in iterator:
-            loss = self.train_batch_async(batch)
+            buf.append(batch)
+            if len(buf) == group:
+                loss = self._train_group(buf)
+                acc = loss if acc is None else acc + loss
+                pairs += sum(b.count for b in buf)
+                buf = []
+        if buf:
+            loss = self._train_group(buf)
             acc = loss if acc is None else acc + loss
-            pairs += batch.count
+            pairs += sum(b.count for b in buf)
         return 0.0 if acc is None else float(acc), pairs
 
     def prepared(self, batches):
@@ -558,6 +675,22 @@ class PSWord2Vec(Word2Vec):
         # slow relative to HBM). Cross-process transports serialize, so
         # they take the host-buffer path.
         self._device_path = zoo.net.in_process
+        # FROZEN row buckets: each batch's unique row count is bounded
+        # by what the batch can touch, so padding every request to that
+        # one bound gives exactly one compiled gather/step/scatter shape
+        # per table — warming 2 batches covers the whole compile set.
+        # (A floating power-of-two ladder compiles a program PER
+        # distinct size combination, serially, on first touch — the
+        # round-2 "warmup tax" that cost ~300s per run.)
+        from ...updater.engine import bucket_size
+        batch = config.batch_size
+        in_cap = batch * (2 * config.window if config.cbow else 1)
+        if config.hs:
+            out_cap = batch * int(self._points_host.shape[1])
+        else:
+            out_cap = batch * (1 + config.negative)
+        self._pad_in_min = bucket_size(min(in_cap, vocab))
+        self._pad_out_min = bucket_size(min(out_cap, self._out_rows))
         self._step = self._build_ps_step()
 
     def _build_ps_step(self):

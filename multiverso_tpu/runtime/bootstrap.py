@@ -71,11 +71,30 @@ def exchange_endpoints(process_id: int, num_processes: int,
                       my_endpoint: str,
                       timeout_ms: int = 120_000) -> List[str]:
     """All-gather of control endpoints through the jax.distributed
-    coordinator's key-value store."""
+    coordinator's key-value store.
+
+    Keys are deleted after a coordinator barrier confirms every process
+    has read the full set: a re-init against a still-running coordinator
+    (restart without a fresh coordinator) must not read the previous
+    run's stale endpoints, and the coordinator KV store rejects
+    overwrites of live keys."""
     client = _coordinator_client()
-    client.key_value_set(f"{_KEY_PREFIX}{process_id}", my_endpoint)
-    return [client.blocking_key_value_get(f"{_KEY_PREFIX}{i}", timeout_ms)
-            for i in range(num_processes)]
+    my_key = f"{_KEY_PREFIX}{process_id}"
+    try:  # clear a leftover from a run that died mid-bootstrap
+        client.key_value_delete(my_key)
+    except Exception:  # noqa: BLE001 - absent key / older jax
+        pass
+    client.key_value_set(my_key, my_endpoint)
+    endpoints = [
+        client.blocking_key_value_get(f"{_KEY_PREFIX}{i}", timeout_ms)
+        for i in range(num_processes)]
+    try:
+        client.wait_at_barrier("multiverso_tpu_bootstrap", timeout_ms)
+        if process_id == 0:
+            client.key_value_delete(_KEY_PREFIX)  # directory delete
+    except Exception as exc:  # noqa: BLE001 - cleanup is best-effort
+        log.info("bootstrap key cleanup skipped: %s", exc)
+    return endpoints
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

@@ -17,15 +17,16 @@ from typing import Deque, List
 
 from ..core.message import Message, MsgType
 from ..util import log
-from ..util.configure import define_double, get_flag
+from ..util.configure import define_int, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from .actor import Actor
 
-define_double("backup_worker_ratio", 0.0,
-              "reserved: fraction of workers treated as backups by the "
-              "sync server (defined-but-unused in the reference too, "
-              "ref: src/server.cpp:21 — kept for flag-surface parity)")
+define_int("backup_worker_ratio", 0,
+           "reserved: integer PERCENTAGE of workers treated as backups "
+           "by the sync server ('set 20 means 20%' — defined-but-unused "
+           "in the reference too, ref: src/server.cpp:21; int to mirror "
+           "the reference flag surface exactly)")
 
 _INF = float("inf")
 

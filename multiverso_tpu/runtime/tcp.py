@@ -39,6 +39,7 @@ from ..core.blob import Blob
 from ..core.message import HEADER_SIZE, Message
 from ..util import log
 from ..util.configure import define_int, define_string, get_flag
+from ..util.dashboard import monitor
 from ..util.mt_queue import MtQueue
 from ..util.net_util import local_addresses
 from .net import NetInterface
@@ -157,13 +158,18 @@ class TcpNet(NetInterface):
         return len(self._peers)
 
     def send(self, msg: Message) -> int:
+        """Serialize + send, each under a Dashboard monitor (the
+        reference instruments exactly these wire phases,
+        ref: mpi_net.h:292-342 MVA_NET_SERIALIZE/SEND sites)."""
         dst = msg.dst
         if not 0 <= dst < self.size:
             raise ValueError(f"bad dst rank {dst}")
-        frame = _serialize(msg)
-        with self._out_locks[dst]:
-            sock = self._connect(dst)
-            sock.sendall(frame)
+        with monitor("tcp_serialize"):
+            frame = _serialize(msg)
+        with monitor("tcp_send"):
+            with self._out_locks[dst]:
+                sock = self._connect(dst)
+                sock.sendall(frame)
         return len(frame)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
@@ -184,10 +190,16 @@ class TcpNet(NetInterface):
         for dst, sock in list(self._out.items()):
             # Goodbye frame (length 0): tells the peer's reader this
             # close is GRACEFUL, so peer-death detection stays quiet.
-            # Take the per-destination send lock so the goodbye cannot
-            # interleave into a frame a sender is mid-writing.
-            with self._out_locks[dst]:
+            # Take the per-destination send lock (with a bound — a
+            # wedged sender must not hang shutdown) so the goodbye
+            # cannot interleave into a frame a sender is mid-writing,
+            # and bound the send itself: a peer that is alive but not
+            # reading (full receive buffer) would otherwise block
+            # sendall indefinitely.
+            locked = self._out_locks[dst].acquire(timeout=2.0)
+            try:
                 try:
+                    sock.settimeout(2.0)
                     sock.sendall(_LEN.pack(0))
                 except OSError:
                     pass
@@ -195,6 +207,9 @@ class TcpNet(NetInterface):
                     sock.close()
                 except OSError:
                     pass
+            finally:
+                if locked:
+                    self._out_locks[dst].release()
         self._out.clear()
         self._inbox.exit()
 
@@ -261,10 +276,13 @@ class TcpNet(NetInterface):
                 if total == 0:  # goodbye frame: graceful peer close
                     clean = True
                     return
-                body = _read_exact(conn, total)
+                with monitor("tcp_recv"):
+                    body = _read_exact(conn, total)
                 if body is None:
                     return
-                self._inbox.push(_deserialize(body))
+                with monitor("tcp_deserialize"):
+                    msg = _deserialize(body)
+                self._inbox.push(msg)
             clean = True
         except OSError:
             return  # torn down mid-read

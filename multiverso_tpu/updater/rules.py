@@ -55,6 +55,13 @@ define_string("updater_type", "default",
 ADAGRAD_EPS = 1e-6  # ref: adagrad_updater.h:18
 
 
+def _safe_lr(lr):
+    """Rules that recover the gradient as delta/lr must not turn a
+    user-supplied learning_rate=0 into inf/NaN written silently into the
+    table — clamp away from zero (delta is 0 whenever lr is)."""
+    return jnp.maximum(lr, jnp.asarray(1e-12, lr.dtype))
+
+
 class UpdaterRule:
     """A pure update rule: (data, state, delta, hyp, worker_id) -> (data, state)."""
 
@@ -122,14 +129,14 @@ class AdaGradRule(UpdaterRule):
 
     def dense(self, data, state, delta, hyp, worker_id):
         lr, rho = hyp[1].astype(data.dtype), hyp[2].astype(data.dtype)
-        grad = delta / lr
+        grad = delta / _safe_lr(lr)
         g_sqr = state[worker_id] + grad * grad
         step = rho * grad * jax.lax.rsqrt(g_sqr + ADAGRAD_EPS)
         return data - step, state.at[worker_id].set(g_sqr)
 
     def rows(self, data, state, row_ids, delta, hyp, worker_id):
         lr, rho = hyp[1].astype(data.dtype), hyp[2].astype(data.dtype)
-        grad = delta / lr
+        grad = delta / _safe_lr(lr)
         g_rows = state.at[worker_id, row_ids].get(mode="fill", fill_value=0)
         g_sqr = g_rows + grad * grad
         step = rho * grad * jax.lax.rsqrt(g_sqr + ADAGRAD_EPS)
@@ -161,14 +168,14 @@ class DCASGDRule(UpdaterRule):
 
     def dense(self, data, state, delta, hyp, worker_id):
         lr, lam = hyp[1].astype(data.dtype), hyp[3].astype(data.dtype)
-        grad = delta / lr
+        grad = delta / _safe_lr(lr)
         comp = lam * grad * grad * (data - state[worker_id])
         new = data - (delta + lr * comp)
         return new, state.at[worker_id].set(new)
 
     def rows(self, data, state, row_ids, delta, hyp, worker_id):
         lr, lam = hyp[1].astype(data.dtype), hyp[3].astype(data.dtype)
-        grad = delta / lr
+        grad = delta / _safe_lr(lr)
         rows_now = data.at[row_ids].get(mode="fill", fill_value=0)
         bak = state.at[worker_id, row_ids].get(mode="fill", fill_value=0)
         step = delta + lr * lam * grad * grad * (rows_now - bak)

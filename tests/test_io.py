@@ -111,13 +111,29 @@ class TestHttpStream:
         store = {}
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def _authorized(self):
+                # When the store holds a "__require_auth__" sentinel,
+                # demand that exact Authorization header.
+                needed = store.get("__require_auth__")
+                if needed is None:
+                    return True
+                if self.headers.get("Authorization") == needed.decode():
+                    return True
+                self.send_response(401)
+                self.end_headers()
+                return False
+
             def do_PUT(self):
+                if not self._authorized():
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 store[self.path] = self.rfile.read(length)
                 self.send_response(201)
                 self.end_headers()
 
             def do_GET(self):
+                if not self._authorized():
+                    return
                 body = store.get(self.path)
                 if body is None:
                     self.send_response(404)
@@ -147,6 +163,47 @@ class TestHttpStream:
         assert store["/obj/blob.bin"] == payload
         with StreamFactory.get_stream(f"{base}/obj/blob.bin", "r") as s:
             assert s.read() == payload
+
+    def test_auth_headers_attached(self, http_store):
+        # The hdfs role was an AUTHENTICATED store
+        # (ref: hdfs_stream.h:10-60): a server demanding credentials
+        # must reject bare requests and accept set_auth'd ones, for
+        # both static dicts and per-uri callables.
+        from multiverso_tpu.io import http_stream
+        base, store = http_store
+        store["/secret.bin"] = b"classified"
+        store["__require_auth__"] = b"Bearer tok123"
+        try:
+            with pytest.raises(Exception):
+                with StreamFactory.get_stream(f"{base}/secret.bin",
+                                              "r") as s:
+                    s.read()
+            http_stream.set_auth({"Authorization": "Bearer tok123"})
+            with StreamFactory.get_stream(f"{base}/secret.bin", "r") as s:
+                assert s.read() == b"classified"
+            http_stream.set_auth(
+                lambda uri: {"Authorization": "Bearer tok123"})
+            with StreamFactory.get_stream(f"{base}/auth_put.bin",
+                                          "w") as s:
+                s.write(b"payload")
+            assert store["/auth_put.bin"] == b"payload"
+        finally:
+            http_stream.set_auth(None)
+
+    def test_env_token_default(self, http_store, monkeypatch):
+        from multiverso_tpu.io import http_stream
+        base, store = http_store
+        store["/tok.bin"] = b"x"
+        store["__require_auth__"] = b"Bearer envtok"
+        monkeypatch.setenv("MV_HTTP_AUTH_TOKEN", "envtok")
+        # Bare token must NOT ride plain http to an unnamed host...
+        with pytest.raises(Exception):
+            with StreamFactory.get_stream(f"{base}/tok.bin", "r") as s:
+                s.read()
+        # ...but is attached once the host is explicitly scoped.
+        monkeypatch.setenv("MV_HTTP_AUTH_HOST", "127.0.0.1")
+        with StreamFactory.get_stream(f"{base}/tok.bin", "r") as s:
+            assert s.read() == b"x"
 
     def test_text_reader_over_http(self, http_store):
         import multiverso_tpu.io.http_stream  # noqa: F401

@@ -147,6 +147,116 @@ class TestPairGeneration:
         np.testing.assert_array_equal(np.asarray(model._emb_in), before)
 
 
+class TestStopwords:
+    def test_cli_stopwords_filtered(self, tmp_path):
+        # ref: Applications/WordEmbedding/src/reader.cpp — the -stopwords
+        # table drops listed words before training.
+        from multiverso_tpu.models.wordembedding.main import run
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("the a0 the a1 the a2 a0 a1\n"
+                          "the a1 a2 the a0 a2 a1 a0\n" * 10)
+        stop = tmp_path / "stop.txt"
+        stop.write_text("the\n")
+        model = run([f"-train_file={corpus}", f"-stopwords={stop}",
+                     "-min_count=1", "-size=8", "-epoch=1",
+                     "-batch_size=64",
+                     f"-output_file={tmp_path / 'v.txt'}"])
+        assert "the" not in model.dictionary.word2id
+        assert "a0" in model.dictionary.word2id
+
+
+class TestDeviceCorpusTrainer:
+    def test_device_pipeline_separates_topics(self, tmp_path):
+        # The HBM-resident pipeline (in-jit subsample/window/negatives)
+        # must learn the same structure the host-batch path does.
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        config = Word2VecConfig(embedding_size=16, window=3, epochs=3,
+                                init_learning_rate=0.01, batch_size=1024,
+                                sample=0)
+        model = Word2Vec(config, d)
+        trainer = DeviceCorpusTrainer(model, tok, centers_per_step=128,
+                                      steps_per_dispatch=4)
+        losses = []
+        for epoch in range(3):
+            loss, pairs = trainer.train_epoch(seed=epoch)
+            losses.append(loss / max(pairs, 1))
+        assert losses[-1] < losses[0], losses
+        sep = topic_separation(model, d)
+        assert sep > 0.3, f"separation {sep}"
+        assert model.trained_words == pytest.approx(3 * tok.flat.size)
+
+    def test_device_pipeline_subsample_counts(self, tmp_path):
+        # With aggressive subsampling the trained pair count must drop
+        # but raw-word accounting (the lr clock) must still cover the
+        # whole corpus (ref: reader.cpp counts discarded words too).
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        pair_counts = {}
+        for sample in (0, 1e-4):
+            config = Word2VecConfig(embedding_size=8, window=3, epochs=1,
+                                    batch_size=256, sample=sample)
+            model = Word2Vec(config, d)
+            trainer = DeviceCorpusTrainer(model, tok,
+                                          centers_per_step=128,
+                                          steps_per_dispatch=2)
+            _, pairs = trainer.train_epoch(seed=0)
+            pair_counts[sample] = pairs
+            assert model.trained_words == pytest.approx(tok.flat.size)
+        assert pair_counts[1e-4] < 0.7 * pair_counts[0]
+
+    def test_device_pipeline_rejects_cbow_hs(self, tmp_path):
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=20)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        model = Word2Vec(Word2VecConfig(embedding_size=8, cbow=True), d)
+        with pytest.raises(ValueError):
+            DeviceCorpusTrainer(model, tok)
+
+
+class TestBatchGroup:
+    @pytest.mark.parametrize("mode", ["sgns", "cbow", "hs"])
+    def test_grouped_scan_matches_sequential(self, tmp_path, mode):
+        # The lax.scan multi-step must be bit-identical to dispatching
+        # the same batches one step at a time (same key-split order) —
+        # including a short tail group padded with count=0 slots.
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=60)
+        d = Dictionary.build(str(path), min_count=1)
+        kw = {"cbow": mode == "cbow", "hs": mode == "hs"}
+        if mode == "hs":
+            kw["negative"] = 0
+        embs = []
+        for group in (1, 4):
+            config = Word2VecConfig(embedding_size=8, window=3, epochs=2,
+                                    batch_size=256, sample=0,
+                                    batch_group=group, **kw)
+            model = Word2Vec(config, d)
+            loss = 0.0
+            # TWO epochs: each ends with a padded tail group, which must
+            # not desync the per-batch key stream across epochs.
+            for epoch in range(2):
+                ep_loss, pairs = model.train_batches(iter_pair_batches(
+                    d, str(path), batch_size=256, window=3, subsample=0,
+                    cbow=config.cbow, seed=5 + epoch))
+                loss += ep_loss
+                assert pairs > 256  # several batches incl. a padded tail
+            embs.append((model.embeddings, loss))
+        np.testing.assert_array_equal(embs[0][0], embs[1][0])
+        assert embs[0][1] == pytest.approx(embs[1][1], rel=1e-6)
+
+
 def train_and_separate(tmp_path, **config_kw):
     path = tmp_path / "corpus.txt"
     write_topic_corpus(path)
