@@ -86,20 +86,6 @@ def _build(corpus: str):
     return dictionary, tokenized
 
 
-def _timed_batches(gen, walls, words):
-    """Record per-batch dispatch walls + word counts around a batch
-    stream. NOTE: in an async pipeline these intervals measure dispatch
-    cadence; callers must pair them with an end-to-end elapsed (run_ps
-    reports both)."""
-    last = time.perf_counter()
-    for batch in gen:
-        yield batch
-        now = time.perf_counter()
-        walls.append(now - last)
-        words.append(batch.words)
-        last = now
-
-
 LOCAL_CENTERS = 32768  # centers per device step (window pairs ≈ 2W x C)
 LOCAL_DISPATCH = 8     # steps per dispatch group (lax.scan length)
 SYNC_GROUPS = 4        # timing-window width, in dispatch groups
@@ -146,7 +132,7 @@ def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
     # clock starts (dispatch is async; the transfers would otherwise
     # land inside the first timed window).
     float(model._emb_in[0, 0])
-    float(trainer._flat[0])
+    float(trainer._corpus.flat[0])
     walls, words = [], []
     state = {"t": None, "acc": 0.0, "n": 0}
 
@@ -190,8 +176,12 @@ def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
 
 
 def run_ps(corpus: str, prebuilt=None) -> dict:
-    """Same workload through the parameter-server path (row-sparse
-    pulls, compact step, delta pushes, pipelined).
+    """Same workload through the parameter-server path: the HBM corpus
+    pipeline driving PS matrix tables with DEVICE-RESIDENT keys — every
+    block's pull/train/push crosses the full worker/server actor stack
+    (models/wordembedding/device_train.py PSDeviceCorpusTrainer). A
+    short host-batch PS segment (the cross-process-capable path) is
+    timed alongside for continuity with earlier rounds.
 
     Single worker by design: N virtual ranks on ONE device measure
     contention, not scaling (each reference worker owns its hardware);
@@ -200,17 +190,58 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
     __graft_entry__.dryrun_multichip."""
     import multiverso_tpu as mv
     from multiverso_tpu.models.wordembedding import (BlockLoader,
+                                                     PSDeviceCorpusTrainer,
                                                      PSWord2Vec,
                                                      Word2VecConfig,
                                                      iter_pair_batches)
     dictionary, tokenized = prebuilt if prebuilt else _build(corpus)
     mv.init([])
     config = Word2VecConfig(embedding_size=DIM, window=5, negative=NEG,
-                            epochs=1, batch_size=BATCH, sample=1e-3,
+                            epochs=EPOCHS, batch_size=BATCH, sample=1e-3,
                             use_ps=True)
     model = PSWord2Vec(config, dictionary)
+    trainer = PSDeviceCorpusTrainer(model, tokenized, LOCAL_CENTERS)
 
-    def capped(seed, cap=PS_MAX_BATCHES):
+    # Warm OUTSIDE the timed region (compiles: block-id program, table
+    # gathers, the step, the server scatter engines incl. both donated
+    # layout variants). The COLD rate (compile included) is reported
+    # alongside.
+    cold_start = time.perf_counter()
+    trainer.train_epoch(seed=99, max_steps=4)
+    warm_secs = time.perf_counter() - cold_start
+    warm_words = model.trained_words
+
+    walls, words_acc = [], []
+    state = {"t": None, "acc": 0.0, "n": 0}
+
+    def hook(w):
+        state["acc"] += w
+        state["n"] += 1
+        if state["n"] % (SYNC_GROUPS * LOCAL_DISPATCH) == 0:
+            float(trainer.last_loss)  # force the dispatched chain
+            now = time.perf_counter()
+            walls.append(now - state["t"])
+            words_acc.append(state["acc"])
+            state["t"] = now
+            state["acc"] = 0.0
+
+    start = time.perf_counter()
+    state["t"] = start
+    loss_sum = 0.0
+    pairs = 0.0
+    for epoch in range(EPOCHS):
+        ep_loss, ep_pairs = trainer.train_epoch(seed=epoch,
+                                                block_hook=hook)
+        loss_sum += ep_loss
+        pairs += ep_pairs
+    elapsed = time.perf_counter() - start
+    words = model.trained_words - warm_words
+    med = float(np.median(walls)) if walls else 0.0
+    median_wps = (float(np.mean(words_acc)) / med) if med else 0.0
+
+    # Host-batch PS segment (row-set prep on the host, the path that
+    # also runs cross-process over TCP): a short pipelined stretch.
+    def capped(seed, cap):
         for i, batch in enumerate(iter_pair_batches(
                 dictionary, tokenized, batch_size=BATCH, window=5,
                 subsample=1e-3, seed=seed)):
@@ -218,67 +249,56 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
                 return
             yield batch
 
-    # Warm OUTSIDE the timed region: with the FROZEN row buckets (one
-    # gather/step/scatter shape per table — see PSWord2Vec frozen pad
-    # minimums) 3 serial batches cover the whole compile set (incl. the
-    # donated-scatter layout variants), then a short PIPELINED stretch
-    # brings the loader/actor/device pipeline to steady state — words/s
-    # is a rate, and a cold pipeline would understate it. The COLD rate
-    # (compile included) is reported alongside.
-    cold_start = time.perf_counter()
-    for warm_batch in capped(99, cap=3):
+    for warm_batch in capped(99, 3):
         model.train_batch(warm_batch)
-    model.train_batches(BlockLoader(model.prepared(capped(98, cap=10))))
-    warm_secs = time.perf_counter() - cold_start
-    warm_words = model.trained_words
-    batch_walls = []
-    batch_words = []
-    start = time.perf_counter()
-    loss_sum, pairs = model.train_batches(_timed_batches(
-        BlockLoader(model.prepared(capped(0))),
-        batch_walls, batch_words))
-    elapsed = time.perf_counter() - start
-    words = model.trained_words - warm_words
-    # Median per-batch rate: robust to transient transport stalls that
-    # the wall-clock average (the headline wps) folds in.
-    # Approximation by design: mean(words) over median(wall) — batch
-    # sizes are near-constant, and interval i spans batch i's
-    # prepare/launch plus batch i-1's finish (pipelined loop).
-    med = float(np.median(batch_walls)) if batch_walls else 0.0
-    median_wps = (float(np.mean(batch_words)) / med) if med else 0.0
-    words_total = model.trained_words  # before the (untimed) trace run
+    # Bring the loader/actor/device pipeline to steady state before
+    # timing — words/s is a rate, and a cold pipeline understates it.
+    model.train_batches(BlockLoader(model.prepared(capped(98, 10))))
+    hb_words_0 = model.trained_words
+    hb_start = time.perf_counter()
+    model.train_batches(BlockLoader(model.prepared(
+        capped(0, PS_MAX_BATCHES))))
+    hb_elapsed = time.perf_counter() - hb_start
+    hostbatch_wps = (model.trained_words - hb_words_0) / hb_elapsed
     # Observability artifacts for the overhead hunt: the Dashboard
-    # counter report (stderr) and an xprof trace of a few PS batches
+    # counter report (stderr) and an xprof trace of a few PS blocks
     # (ref: the reference ends its perf harness with Dashboard::Display,
     # Test/test_matrix_perf.cpp:125).
     from multiverso_tpu.util.dashboard import Dashboard, trace_to
     trace_dir = os.path.join(tempfile.gettempdir(), "mv_ps_xprof")
     try:
         with trace_to(trace_dir):
-            model.train_batches(BlockLoader(model.prepared(capped(97,
-                                                                  4))))
+            trainer.train_epoch(seed=97, max_steps=4)
     except Exception as exc:  # noqa: BLE001 - tracing is best-effort
         trace_dir = f"unavailable: {exc}"
     dashboard = Dashboard.display()
     print(f"[bench] PS dashboard:\n{dashboard}", file=sys.stderr)
     print(f"[bench] PS xprof trace: {trace_dir}", file=sys.stderr)
-    separation = topic_separation(model.embeddings, dictionary)
+    model._drain_pushes()
+    separation = topic_separation(
+        None, dictionary,
+        fetch_rows=lambda ids: model._in_table.get_rows(ids))
     mv.shutdown()
     assert np.isfinite(loss_sum / max(pairs, 1))
     return {"wps": words / elapsed,
             "dashboard": dashboard.splitlines(),
             "xprof_trace_dir": trace_dir,
-            "cold_wps": round(words_total / (warm_secs + elapsed), 0),
+            "cold_wps": round(
+                (words + warm_words) / (warm_secs + elapsed), 0),
             "warmup_seconds": round(warm_secs, 1),
             "median_batch_wps": round(float(median_wps), 0),
+            "hostbatch_wps": round(hostbatch_wps, 0),
             "avg_loss": round(loss_sum / max(pairs, 1), 4),
             "separation": round(float(separation), 4)}
 
 
-def topic_separation(emb: np.ndarray, dictionary) -> float:
+def topic_separation(emb: np.ndarray, dictionary,
+                     fetch_rows=None) -> float:
     """Within-band minus cross-band cosine similarity of the most
     frequent words of each topic band (quality signal; positive =
-    embeddings learned the corpus structure)."""
+    embeddings learned the corpus structure). ``fetch_rows(ids)``
+    fetches just the scored rows — a PS table's full-matrix download
+    would ship the whole table over the host link for 48 rows."""
     half = VOCAB // 2
     per_band = 24
     band_a, band_b = [], []
@@ -287,8 +307,13 @@ def topic_separation(emb: np.ndarray, dictionary) -> float:
         (band_a if raw < half else band_b).append(wid)
         if len(band_a) >= per_band and len(band_b) >= per_band:
             break
-    a = emb[band_a[:per_band]]
-    b = emb[band_b[:per_band]]
+    band_a, band_b = band_a[:per_band], band_b[:per_band]
+    if fetch_rows is not None:
+        rows = fetch_rows(np.array(band_a + band_b, np.int32))
+        a, b = rows[:per_band], rows[per_band:]
+    else:
+        a = emb[band_a]
+        b = emb[band_b]
     a = a / np.maximum(np.linalg.norm(a, axis=1, keepdims=True), 1e-9)
     b = b / np.maximum(np.linalg.norm(b, axis=1, keepdims=True), 1e-9)
     within = ((a @ a.T).mean() + (b @ b.T).mean()) / 2
@@ -534,13 +559,18 @@ def main() -> None:
             "ps_cold_words_per_sec": ps["cold_wps"],
             "ps_warmup_seconds": ps["warmup_seconds"],
             "ps_median_batch_words_per_sec": ps["median_batch_wps"],
+            "ps_hostbatch_words_per_sec": ps["hostbatch_wps"],
             "ps_vs_local": round(ps["wps"] / local["wps"], 3),
             "ps_avg_loss": ps["avg_loss"],
             "ps_topic_separation": ps["separation"],
             "ps_dashboard": ps["dashboard"],
             "ps_xprof_trace_dir": ps["xprof_trace_dir"],
+            # Row-fetch form: np.asarray(model.embeddings) would pull
+            # the whole table over the host link for 48 scored rows.
             "local_topic_separation": round(float(topic_separation(
-                local["model"].embeddings, local["dictionary"])), 4),
+                None, local["dictionary"],
+                fetch_rows=lambda ids: np.asarray(
+                    local["model"]._emb_in[ids]))), 4),
             "loss_parity": parity if parity else baseline_err,
             "mfu": util["mfu"],
             "utilization": util,

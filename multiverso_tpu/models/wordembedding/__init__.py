@@ -2,7 +2,8 @@
 
 from .data import (BlockLoader, CbowBatch, PairBatch, TokenizedCorpus,  # noqa: F401
                    iter_pair_batches, iter_sentences, sentence_pairs)
-from .device_train import DeviceCorpusTrainer  # noqa: F401
+from .device_train import (DeviceCorpusTrainer,  # noqa: F401
+                           PSDeviceCorpusTrainer)
 from .dictionary import Dictionary  # noqa: F401
 from .huffman import HuffmanTree, build_huffman  # noqa: F401
 from .model import PSWord2Vec, Word2Vec, Word2VecConfig  # noqa: F401

@@ -57,63 +57,79 @@ def _prep(flat, sent, keep, key):
     return kept, ksent, mask.sum(dtype=jnp.int32)
 
 
+def _window_and_negs(C, W, K, n, kept, ksent, neg_prob, neg_alias, key,
+                     base, n_kept):
+    """The in-jit batch former shared by the local and PS pipelines:
+    C consecutive kept positions as centers, the per-center shrunk
+    window masked against sentence bounds (the word2vec trick,
+    ref: wordembedding.cpp Train window sampling), and K negatives PER
+    CENTER via the alias tables — shared by that center's (at most 2W)
+    context pairs with the negative loss weighted by the center's
+    valid-pair count. Expected gradient equals the reference's per-pair
+    draws (each pair still sees K ^0.75-unigram negatives); sharing
+    cuts the negative draw/gather/scatter volume 2W-fold, which is what
+    the random 4-byte alias lookups and 512-byte row gathers are bound
+    by on TPU. Returns (centers[C], ctx[C,2W], negs[C,K], pmask[C,2W])."""
+    offs = np.concatenate([np.arange(-W, 0),
+                           np.arange(1, W + 1)]).astype(np.int32)
+    offs_dev = jnp.asarray(offs)
+    abs_offs = jnp.asarray(np.abs(offs))
+    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+    idx = base + jnp.arange(C, dtype=jnp.int32)
+    safe = jnp.minimum(idx, n - 1)
+    centers = kept[safe]
+    csent = ksent[safe]
+    center_ok = (idx < n_kept) & (csent >= 0)
+    shrink = jax.random.randint(k_shrink, (C,), 1, W + 1)
+    cpos = idx[:, None] + offs_dev[None, :]  # [C, 2W]
+    inb = (cpos >= 0) & (cpos < n_kept)
+    cposc = jnp.clip(cpos, 0, n - 1)
+    ctx = kept[cposc]
+    valid = (inb & (ksent[cposc] == csent[:, None])
+             & (abs_offs[None, :] <= shrink[:, None])
+             & center_ok[:, None])
+    draw = jax.random.randint(k_idx, (C, K), 0, neg_prob.shape[0])
+    keep_draw = jax.random.uniform(k_keep, (C, K)) < neg_prob[draw]
+    negs = jnp.where(keep_draw, draw, neg_alias[draw])
+    return centers, ctx, negs, valid.astype(jnp.float32)
+
+
+def _sgns_loss_and_grads(v, u_ctx, u_neg, pmask):
+    """Shared SGNS objective over gathered rows: sigmoid xent at label
+    1 for context pairs (masked) and label 0 for the per-center shared
+    negatives (weighted by the center's valid-pair count). Returns
+    (loss, g_v, g_ctx, g_neg)."""
+    nvalid = pmask.sum(axis=1)
+
+    def loss_fn(v, u_ctx, u_neg):
+        pos = jnp.clip(jnp.einsum("cd,cwd->cw", v, u_ctx),
+                       -_MAX_EXP, _MAX_EXP)
+        neg = jnp.clip(jnp.einsum("cd,ckd->ck", v, u_neg),
+                       -_MAX_EXP, _MAX_EXP)
+        xp = _sigmoid_xent(pos, 1.0) * pmask
+        xn = _sigmoid_xent(neg, 0.0) * nvalid[:, None]
+        return xp.sum() + xn.sum()
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+        v, u_ctx, u_neg)
+    return (loss,) + grads
+
+
 # Module-level cache so every trainer instance with the same static
 # shape (C, window, negative, corpus length) shares one compiled group
 # program — a warmup trainer's compile pays for the timed one.
 @functools.lru_cache(maxsize=None)
 def _group_fn(C: int, W: int, K: int, n: int):
-    offs = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
-    offs_host = offs.astype(np.int32)
-    abs_offs_host = np.abs(offs).astype(np.int32)
-
     def step(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
              key, base, lr, n_kept):
-        offs_dev = jnp.asarray(offs_host)
-        abs_offs = jnp.asarray(abs_offs_host)
-        k_shrink, k_idx, k_keep = jax.random.split(key, 3)
-        idx = base + jnp.arange(C, dtype=jnp.int32)
-        safe = jnp.minimum(idx, n - 1)
-        centers = kept[safe]
-        csent = ksent[safe]
-        center_ok = (idx < n_kept) & (csent >= 0)
-        # Per-center shrunk window (the word2vec trick, ref:
-        # wordembedding.cpp Train window sampling).
-        shrink = jax.random.randint(k_shrink, (C,), 1, W + 1)
-        cpos = idx[:, None] + offs_dev[None, :]  # [C, 2W]
-        inb = (cpos >= 0) & (cpos < n_kept)
-        cposc = jnp.clip(cpos, 0, n - 1)
-        ctx = kept[cposc]
-        valid = (inb & (ksent[cposc] == csent[:, None])
-                 & (abs_offs[None, :] <= shrink[:, None])
-                 & center_ok[:, None])
-        pmask = valid.astype(jnp.float32)
-        # K negatives PER CENTER, shared by that center's (at most 2W)
-        # context pairs with the negative loss weighted by the center's
-        # valid-pair count. Expected gradient equals the reference's
-        # per-pair draws (each pair still sees K ^0.75-unigram
-        # negatives); sharing cuts the negative draw/gather/scatter
-        # volume 2W-fold, which is what the random 4-byte alias lookups
-        # and 512-byte row gathers are bound by on TPU.
-        draw = jax.random.randint(k_idx, (C, K), 0, neg_prob.shape[0])
-        keep_draw = jax.random.uniform(k_keep, (C, K)) < neg_prob[draw]
-        negs = jnp.where(keep_draw, draw, neg_alias[draw])
-
+        centers, ctx, negs, pmask = _window_and_negs(
+            C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base,
+            n_kept)
         v = emb_in[centers]          # [C, D]
         u_ctx = emb_out[ctx]         # [C, 2W, D]
         u_neg = emb_out[negs]        # [C, K, D]
-        nvalid = pmask.sum(axis=1)   # [C]
-
-        def loss_fn(v, u_ctx, u_neg):
-            pos = jnp.clip(jnp.einsum("cd,cwd->cw", v, u_ctx),
-                           -_MAX_EXP, _MAX_EXP)
-            neg = jnp.clip(jnp.einsum("cd,ckd->ck", v, u_neg),
-                           -_MAX_EXP, _MAX_EXP)
-            xp = _sigmoid_xent(pos, 1.0) * pmask
-            xn = _sigmoid_xent(neg, 0.0) * nvalid[:, None]
-            return xp.sum() + xn.sum()
-
-        loss, (g_v, g_ctx, g_neg) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1, 2))(v, u_ctx, u_neg)
+        loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
+            v, u_ctx, u_neg, pmask)
         emb_in = emb_in.at[centers].add(-lr * g_v)
         out_ids = jnp.concatenate([ctx, negs], axis=1)
         g_out = jnp.concatenate([g_ctx, g_neg], axis=1)
@@ -138,6 +154,30 @@ def _group_fn(C: int, W: int, K: int, n: int):
     return jax.jit(group, donate_argnums=(0, 1))
 
 
+class _CorpusOnDevice:
+    """Shared upload of a ``TokenizedCorpus``: the flat id stream, its
+    per-token sentence ids, and the subsample keep probabilities — one
+    transfer, reused every epoch by both the local and the PS device
+    trainers."""
+
+    def __init__(self, model, tokenized: TokenizedCorpus):
+        config = model.config
+        if config.cbow or config.hs:
+            raise ValueError("device corpus training covers skip-gram "
+                             "SGNS; use the batch path for cbow/hs")
+        flat = np.asarray(tokenized.flat, np.int32)
+        lengths = np.diff(tokenized.offsets).astype(np.int64)
+        sent = np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
+        self.n_tokens = int(flat.size)
+        self.flat = jnp.asarray(flat)
+        self.sent = jnp.asarray(sent)
+        self.keep = jnp.asarray(
+            model.dictionary.subsample_keep_prob(config.sample))
+
+    def prep_epoch(self, key):
+        return _prep(self.flat, self.sent, self.keep, key)
+
+
 class DeviceCorpusTrainer:
     """Drives a ``Word2Vec`` model's embeddings straight from a
     device-resident ``TokenizedCorpus``. Skip-gram + negative sampling
@@ -148,22 +188,12 @@ class DeviceCorpusTrainer:
                  centers_per_step: int = 32768,
                  steps_per_dispatch: int = 8):
         config = model.config
-        if config.cbow or config.hs:
-            raise ValueError("device corpus training covers skip-gram "
-                             "SGNS; use the batch path for cbow/hs")
         self.model = model
         self.config = config
         self._C = int(centers_per_step)
         self._G = int(steps_per_dispatch)
-        flat = np.asarray(tokenized.flat, np.int32)
-        lengths = np.diff(tokenized.offsets).astype(np.int64)
-        sent = np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
-        self._n_tokens = int(flat.size)
-        # Corpus + per-token sentence id, uploaded once.
-        self._flat = jnp.asarray(flat)
-        self._sent = jnp.asarray(sent)
-        self._keep = jnp.asarray(
-            model.dictionary.subsample_keep_prob(config.sample))
+        self._corpus = _CorpusOnDevice(model, tokenized)
+        self._n_tokens = self._corpus.n_tokens
         self._group = _group_fn(self._C, config.window, config.negative,
                                 self._n_tokens)
         # Post-subsampling tokens actually trained (centers), across
@@ -180,8 +210,7 @@ class DeviceCorpusTrainer:
         model, C, G = self.model, self._C, self._G
         key = jax.random.PRNGKey(seed)
         key, prep_key = jax.random.split(key)
-        kept, ksent, n_kept_dev = _prep(
-            self._flat, self._sent, self._keep, prep_key)
+        kept, ksent, n_kept_dev = self._corpus.prep_epoch(prep_key)
         n_kept = int(n_kept_dev)  # the one host fetch per epoch
         steps = max(math.ceil(n_kept / C), 1)
         if max_steps:
@@ -210,5 +239,130 @@ class DeviceCorpusTrainer:
             pair_acc = pairs if pair_acc is None else pair_acc + pairs
             if group_hook is not None:
                 group_hook(raw_per_step * real)
+        return (0.0 if loss_acc is None else float(loss_acc),
+                0.0 if pair_acc is None else float(pair_acc))
+
+
+@functools.lru_cache(maxsize=None)
+def _block_ids_fn(C: int, W: int, K: int, n: int):
+    """Jitted block preparation for the PS pipeline: centers, the fused
+    output id block [ctx | negatives], and the pair validity mask — all
+    device-resident, ready to hand to the tables as DEVICE keys."""
+
+    def ids(kept, ksent, neg_prob, neg_alias, key, base, n_kept):
+        centers, ctx, negs, pmask = _window_and_negs(
+            C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base,
+            n_kept)
+        return centers, jnp.concatenate([ctx, negs], axis=1), pmask
+
+    return jax.jit(ids)
+
+
+@functools.lru_cache(maxsize=None)
+def _block_step_fn(C: int, W: int, K: int):
+    """Jitted PS block step over PULLED rows: returns the PUSH deltas
+    ``-lr*grad/num_workers`` (the reference's (new-old)/num_workers with
+    one local step, ref: communicator.cpp:157-249) plus loss/pairs."""
+
+    def step(v, u, pmask, lr_scaled):
+        loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
+            v, u[:, :2 * W], u[:, 2 * W:], pmask)
+        g_u = jnp.concatenate([g_ctx, g_neg], axis=1)
+        return -lr_scaled * g_v, -lr_scaled * g_u, loss, pmask.sum()
+
+    return jax.jit(step)
+
+
+class PSDeviceCorpusTrainer:
+    """The PS twin of ``DeviceCorpusTrainer``: same HBM-resident corpus
+    pipeline, but the embeddings live in PARAMETER-SERVER matrix tables
+    — every block pulls its rows through the full worker/server actor
+    stack (device-key Gets), trains, and pushes ``-lr*grad/num_workers``
+    deltas back (device-key Adds). Nothing but learning-rate scalars
+    crosses the host boundary, which is what lets the PS path approach
+    local-mode throughput in-process (the reference's block protocol,
+    ref: Applications/WordEmbedding/src/communicator.cpp:117-249, with
+    the row list living in HBM).
+
+    Requires the in-process device path and a single server (device-key
+    partition); the host-batch ``PSWord2Vec.train_batches`` remains the
+    general path for cross-process / multi-server runs."""
+
+    def __init__(self, model, tokenized: TokenizedCorpus,
+                 centers_per_step: int = 32768):
+        config = model.config
+        if not getattr(model, "_device_path", False):
+            raise ValueError("PS device pipeline needs in-process "
+                             "servers (device path)")
+        if model._in_table._num_server != 1:
+            raise ValueError("PS device pipeline needs a single server "
+                             "(device keys cannot partition)")
+        self.model = model
+        self.config = config
+        self._C = int(centers_per_step)
+        self._corpus = _CorpusOnDevice(model, tokenized)
+        self._n_tokens = self._corpus.n_tokens
+        if not hasattr(model, "_neg_prob_dev"):
+            # PSWord2Vec keeps the alias tables host-side (its batch
+            # path draws negatives on the host); this pipeline samples
+            # in-jit, so upload them once.
+            model._neg_prob_dev = jnp.asarray(model._neg_prob_host)
+            model._neg_alias_dev = jnp.asarray(model._neg_alias_host)
+        self._ids = _block_ids_fn(self._C, config.window,
+                                  config.negative, self._n_tokens)
+        self._step = _block_step_fn(self._C, config.window,
+                                    config.negative)
+        self.kept_words_trained = 0
+
+    def train_epoch(self, seed: int, block_hook=None,
+                    max_steps: int = 0) -> Tuple[float, float]:
+        """One epoch: per block, compute ids on device -> device-key
+        pulls -> jitted step -> device-key delta pushes, all dispatched
+        asynchronously (losses accumulate as device scalars; pushes are
+        fire-and-forget until the trailing drain)."""
+        model, C = self.model, self._C
+        in_table, out_table = model._in_table, model._out_table
+        key = jax.random.PRNGKey(seed)
+        key, prep_key = jax.random.split(key)
+        kept, ksent, n_kept_dev = self._corpus.prep_epoch(prep_key)
+        n_kept = int(n_kept_dev)
+        steps = max(math.ceil(n_kept / C), 1)
+        if max_steps:
+            steps = min(steps, max_steps)
+        self.kept_words_trained += min(steps * C, n_kept)
+        raw_per_step = self._n_tokens / max(math.ceil(n_kept / C), 1)
+        loss_acc = None
+        pair_acc = None
+        for s in range(steps):
+            step_key = jax.random.fold_in(key, s)
+            centers, out_ids, pmask = self._ids(
+                kept, ksent, model._neg_prob_dev, model._neg_alias_dev,
+                step_key, np.int32(s * C), n_kept_dev)
+            # Device-key pulls ride the worker->server actor round trip;
+            # the replies are lazy device arrays (no host sync).
+            mid_in = in_table.get_rows_device_async(centers)
+            mid_out = out_table.get_rows_device_async(out_ids)
+            in_table.wait(mid_in)
+            out_table.wait(mid_out)
+            v = in_table.take_device_rows()
+            u = out_table.take_device_rows()
+            lr_scaled = jnp.float32(
+                model.learning_rate() / model._num_workers)
+            d_v, d_u, loss, pairs = self._step(v, u, pmask, lr_scaled)
+            # Fire-and-forget pushes: waiters self-reap on ack; the
+            # trailing drain below bounds the epoch.
+            model._pending_pushes.append(
+                (in_table, in_table.add_rows_async(centers, d_v)))
+            model._pending_pushes.append(
+                (out_table, out_table.add_rows_async(out_ids, d_u)))
+            model._account_words(raw_per_step)
+            loss_acc = loss if loss_acc is None else loss_acc + loss
+            pair_acc = pairs if pair_acc is None else pair_acc + pairs
+            self.last_loss = loss  # device scalar; bench sync point
+            if block_hook is not None:
+                block_hook(raw_per_step)
+        model._drain_pushes()
+        model._flush_word_count()
+        model._in_table.zoo.barrier()
         return (0.0 if loss_acc is None else float(loss_acc),
                 0.0 if pair_acc is None else float(pair_acc))

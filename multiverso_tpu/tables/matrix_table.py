@@ -218,10 +218,31 @@ class MatrixWorker(WorkerTable):
         return self.take_device_rows()
 
     def get_rows_device_async(self, row_ids) -> int:
-        """Async device row pull. ``row_ids`` must be non-decreasing so
-        each server's reply is one contiguous segment and the result
-        reassembles by concatenation (sorted-unique row sets — possibly
-        tail-padded by repeating the last id — satisfy this)."""
+        """Async device row pull.
+
+        HOST ids must be non-decreasing so each server's reply is one
+        contiguous segment and the result reassembles by concatenation
+        (sorted-unique row sets — possibly tail-padded by repeating the
+        last id — satisfy this).
+
+        DEVICE ids (a ``jax.Array``, single-server tables only — host
+        bytes would be needed to partition across servers) pass through
+        the stack without ever touching the host: any shape, any order,
+        duplicates welcome — the reply is the XLA gather
+        ``table[row_ids]`` with shape ``row_ids.shape + (num_col,)``.
+        This is the key enabler for trainers whose row sets are computed
+        on device (models/wordembedding/device_train.py PS mode)."""
+        if is_device_array(row_ids):
+            CHECK(self._num_server == 1,
+                  "device-key row gets need a single server")
+            CHECK(self._zoo.net.in_process,
+                  "device-key row gets need in-process servers (a "
+                  "serializing transport flattens the keys to host "
+                  "bytes and the reply shape contract breaks)")
+            CHECK(not self._compress, "device gets bypass wire compression")
+            self._dest, self._dest_rows = None, None
+            self._device_shards = {}
+            return self._request_get(Blob(row_ids))
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
         CHECK(row_ids.size > 0, "empty device row get")
         CHECK(not self._compress, "device gets bypass wire compression")
@@ -276,7 +297,23 @@ class MatrixWorker(WorkerTable):
         """Row-delta push. A ``jax.Array`` delta stays on device end to
         end when the servers share the process (scatter-add straight from
         HBM — the device twin of the reference's AddDeltaParameter,
-        communicator.cpp:157-249)."""
+        communicator.cpp:157-249). DEVICE row_ids (single-server,
+        in-process tables) keep the ids in HBM too: any shape; delta
+        must be shaped ``row_ids.shape + (num_col,)``. Duplicate ids
+        SUM only under stateless updaters (default/sgd) — the engine
+        rejects stateful rules on this path."""
+        if is_device_array(row_ids):
+            CHECK(self._num_server == 1,
+                  "device-key row adds need a single server")
+            CHECK(self._zoo.net.in_process,
+                  "device-key row adds need in-process servers")
+            CHECK(is_device_array(delta),
+                  "device-key adds need a device delta")
+            CHECK(tuple(delta.shape) ==
+                  tuple(row_ids.shape) + (self.num_col,),
+                  "bad device delta shape")
+            return self.add_async_raw(Blob(row_ids), Blob(delta),
+                                      self._option_blob(option))
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
         if not is_device_array(delta):
             delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
@@ -320,6 +357,11 @@ class MatrixWorker(WorkerTable):
 
     # -- partition (ref: matrix_table.cpp:234-315) --
     def partition(self, blobs, msg_type) -> Dict[int, List[Blob]]:
+        if blobs[0].on_device:
+            # Device-key requests: single server by construction (the
+            # async entry points CHECK it), so the whole request passes
+            # through without a host round-trip for the id vector.
+            return {0: list(blobs)}
         keys = blobs[0].as_array(np.int32)
         out: Dict[int, List[Blob]] = {}
         if keys.size == 1 and keys[0] == -1:
@@ -406,6 +448,13 @@ class MatrixWorker(WorkerTable):
 
     # -- replies (ref: matrix_table.cpp:317-341) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
+        if reply_blobs[0].on_device:
+            # Device-key reply (single server): values arrive shaped
+            # row_ids.shape + (num_col,), still in HBM.
+            CHECK(self._device_shards is not None,
+                  "device reply with no device get outstanding")
+            self._device_shards[0] = reply_blobs[1].typed(self.dtype)
+            return
         keys = reply_blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
             server_id = int(reply_blobs[2].as_array(np.int32)[0])
@@ -506,6 +555,20 @@ class MatrixServer(ServerTable):
 
     # -- Add (ref: matrix_table.cpp:386-418, sparse_matrix_table.cpp:200-223)
     def process_add(self, blobs: List[Blob]) -> None:
+        if blobs[0].on_device:
+            # Device-key scatter-add: ids and delta never touch the
+            # host. Dense tables only (sparse staleness bookkeeping
+            # needs host ids).
+            CHECK(self._up_to_date is None,
+                  "device-key adds are for dense tables")
+            option = AddOption.from_blob(blobs[2]) \
+                if len(blobs) == 3 else None
+            rows = blobs[0].typed(np.int32)
+            if self.row_offset:
+                rows = rows - self.row_offset
+            self._data = self._engine.apply_rows(
+                self._data, rows, blobs[1].typed(self.dtype), option)
+            return
         keys = blobs[0].as_array(np.int32)
         if self._compress:
             # Compressed wire layout: [keys, values, size_record(, option)]
@@ -566,6 +629,16 @@ class MatrixServer(ServerTable):
 
     # -- Get (ref: matrix_table.cpp:420-454, sparse_matrix_table.cpp:226-309)
     def process_get(self, blobs: List[Blob]) -> List[Blob]:
+        if blobs[0].on_device:
+            # Device-key gather: reply values shaped ids.shape + (C,),
+            # all in HBM. Dense tables only (sparse staleness marks
+            # need host ids).
+            CHECK(self._up_to_date is None,
+                  "device-key gets are for dense tables")
+            rows = blobs[0].typed(np.int32)
+            if self.row_offset:
+                rows = rows - self.row_offset
+            return [blobs[0], Blob(self._gather(self._data, rows))]
         keys = blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
             if self._up_to_date is not None and len(blobs) >= 2:

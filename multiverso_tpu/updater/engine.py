@@ -72,9 +72,23 @@ class UpdateEngine:
     def apply_rows(self, data, row_ids, delta,
                    option: Optional[AddOption] = None):
         """``row_ids`` int32[k], ``delta`` [k, ...]; pads to a power-of-two
-        bucket with out-of-range indices (dropped by scatter)."""
+        bucket with out-of-range indices (dropped by scatter). Device
+        row_ids (any shape, delta shaped ids.shape + row shape) skip
+        padding — the caller's shapes are already fixed, so each distinct
+        caller shape compiles exactly once."""
         hyp, worker_id = _unpack(option)
-        row_ids, delta = pad_rows(row_ids, delta, self.shape[0])
+        from ..core.blob import is_device_array
+        if is_device_array(row_ids):
+            # Device-key ids may carry duplicates, which only SUM
+            # correctly under stateless rules (default/sgd scatter-add);
+            # stateful rules apply .set per unique row and would corrupt
+            # their state silently.
+            from ..util.log import CHECK
+            CHECK(self._state is None,
+                  "device-key row adds need a stateless updater "
+                  "(default/sgd): duplicate ids must sum")
+        else:
+            row_ids, delta = pad_rows(row_ids, delta, self.shape[0])
         data, self._state = self._rows(data, self._state, row_ids, delta,
                                        hyp, worker_id)
         return data

@@ -284,6 +284,42 @@ class TestDeviceResidentPath:
         np.testing.assert_array_equal(got[1], base[5] + 2)  # dup summed
         np.testing.assert_array_equal(got[2], base[31] + 1)
 
+    def test_matrix_device_KEYS_roundtrip(self, env):
+        # Device-RESIDENT id vectors (any shape, unsorted, duplicated)
+        # pull and push without the ids ever touching the host — the
+        # enabler for device-computed row sets (PS device pipeline).
+        import jax.numpy as jnp
+        table = mv.create_matrix_table(32, 4)
+        base = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+        table.add(base)
+        ids = jnp.asarray(np.array([[3, 1], [1, 31], [7, 7]], np.int32))
+        out = table.get_rows_device(ids)
+        assert out.shape == (3, 2, 4)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      base[np.asarray(ids)])
+        # device-key push: duplicates sum (ids 1 and 7 appear twice)
+        table.add_rows(ids, jnp.ones((3, 2, 4), jnp.float32))
+        got = table.get_rows(np.array([3, 1, 31, 7], np.int32))
+        np.testing.assert_array_equal(got[0], base[3] + 1)
+        np.testing.assert_array_equal(got[1], base[1] + 2)
+        np.testing.assert_array_equal(got[2], base[31] + 1)
+        np.testing.assert_array_equal(got[3], base[7] + 2)
+
+    def test_matrix_device_keys_rejected_multi_server(self):
+        def body(rank):
+            import jax.numpy as jnp
+            table = mv.create_matrix_table(10, 3)
+            err = None
+            try:
+                table.get_rows_device(jnp.asarray(
+                    np.array([1, 2], np.int32)))
+            except Exception as exc:  # noqa: BLE001
+                err = "single server" in str(exc)
+            mv.current_zoo().barrier()
+            return err
+
+        assert all(LocalCluster(2).run(body))
+
     def test_matrix_device_rows_two_servers(self):
         # Sorted row ids spanning both servers' ranges reassemble in
         # order; device push partitions into per-server device segments.

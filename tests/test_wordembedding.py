@@ -225,6 +225,37 @@ class TestDeviceCorpusTrainer:
             DeviceCorpusTrainer(model, tok)
 
 
+class TestPSDevicePipeline:
+    def test_ps_device_pipeline_trains_through_tables(self, tmp_path):
+        # The HBM corpus pipeline driving PARAMETER-SERVER tables with
+        # device-resident keys: pulls/pushes ride the full actor stack,
+        # loss decreases, and the trained state lives in the tables.
+        from multiverso_tpu.models.wordembedding import (
+            PSDeviceCorpusTrainer, PSWord2Vec, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        mv.init([])
+        try:
+            config = Word2VecConfig(embedding_size=16, window=3,
+                                    epochs=3, init_learning_rate=0.01,
+                                    batch_size=1024, sample=0)
+            model = PSWord2Vec(config, d)
+            trainer = PSDeviceCorpusTrainer(model, tok,
+                                            centers_per_step=128)
+            losses = []
+            for epoch in range(3):
+                loss, pairs = trainer.train_epoch(seed=epoch)
+                assert pairs > 0
+                losses.append(loss / pairs)
+            assert losses[-1] < losses[0], losses
+            sep = topic_separation(model, d)
+            assert sep > 0.3, f"separation {sep}"
+        finally:
+            mv.shutdown()
+
+
 class TestBatchGroup:
     @pytest.mark.parametrize("mode", ["sgns", "cbow", "hs"])
     def test_grouped_scan_matches_sequential(self, tmp_path, mode):
