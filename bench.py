@@ -91,6 +91,41 @@ LOCAL_DISPATCH = 8     # steps per dispatch group (lax.scan length)
 SYNC_GROUPS = 4        # timing-window width, in dispatch groups
 
 
+class _TimedHook:
+    """Shared per-hook timing with forced device syncs: every ``every``
+    calls, ``sync()`` must force all dispatched work to completion (a
+    tiny scalar readback — block_until_ready is not reliable on the
+    tunneled platform), and one (wall, words) window sample lands.
+    ``median_wps()`` is the steady-state rate estimate."""
+
+    def __init__(self, sync, every: int):
+        self._sync = sync
+        self._every = every
+        self.walls = []
+        self.words = []
+        self._acc = 0.0
+        self._n = 0
+        self._t = None
+
+    def start(self) -> None:
+        self._t = time.perf_counter()
+
+    def __call__(self, words: float) -> None:
+        self._acc += words
+        self._n += 1
+        if self._n % self._every == 0:
+            self._sync()
+            now = time.perf_counter()
+            self.walls.append(now - self._t)
+            self.words.append(self._acc)
+            self._t = now
+            self._acc = 0.0
+
+    def median_wps(self) -> float:
+        med = float(np.median(self.walls)) if self.walls else 0.0
+        return (float(np.mean(self.words)) / med) if med else 0.0
+
+
 def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
               schedule_epochs: int = None, warm: bool = True) -> dict:
     """Train ``epochs`` epochs through the device-resident pipeline
@@ -133,40 +168,20 @@ def run_local(corpus: str, prebuilt=None, epochs: int = EPOCHS,
     # land inside the first timed window).
     float(model._emb_in[0, 0])
     float(trainer._corpus.flat[0])
-    walls, words = [], []
-    state = {"t": None, "acc": 0.0, "n": 0}
-
-    def hook(w):
-        """Per-group timing, device-SYNCED every SYNC_GROUPS groups: a
-        4-byte element read forces all dispatched groups to completion
-        (block_until_ready alone does not reliably block on the
-        tunneled platform), so each window measures real throughput,
-        not dispatch cadence."""
-        state["acc"] += w
-        state["n"] += 1
-        if state["n"] % SYNC_GROUPS == 0:
-            float(model._emb_in[0, 0])
-            now = time.perf_counter()
-            walls.append(now - state["t"])
-            words.append(state["acc"])
-            state["t"] = now
-            state["acc"] = 0.0
-
+    hook = _TimedHook(lambda: float(model._emb_in[0, 0]), SYNC_GROUPS)
     epoch_losses = []
     pair_total = 0.0
     start = time.perf_counter()
-    state["t"] = start
+    hook.start()
     for epoch in range(epochs):
         loss_sum, pairs = trainer.train_epoch(seed=epoch, group_hook=hook)
         epoch_losses.append(loss_sum / max(pairs, 1))
         pair_total += pairs
     elapsed = time.perf_counter() - start
     assert all(np.isfinite(x) for x in epoch_losses), epoch_losses
-    med = float(np.median(walls)) if walls else 0.0
     return {
         "wps": model.trained_words / elapsed,
-        "median_batch_wps": round(
-            float(np.mean(words)) / med, 0) if med else 0.0,
+        "median_batch_wps": round(hook.median_wps(), 0),
         "pairs_per_sec": pair_total / elapsed,
         "centers_per_sec": trainer.kept_words_trained / elapsed,
         "epoch_losses": [round(float(x), 4) for x in epoch_losses],
@@ -211,22 +226,12 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
     warm_secs = time.perf_counter() - cold_start
     warm_words = model.trained_words
 
-    walls, words_acc = [], []
-    state = {"t": None, "acc": 0.0, "n": 0}
-
-    def hook(w):
-        state["acc"] += w
-        state["n"] += 1
-        if state["n"] % (SYNC_GROUPS * LOCAL_DISPATCH) == 0:
-            float(trainer.last_loss)  # force the dispatched chain
-            now = time.perf_counter()
-            walls.append(now - state["t"])
-            words_acc.append(state["acc"])
-            state["t"] = now
-            state["acc"] = 0.0
-
+    # PS blocks are single steps (no scan), so the same wall-clock
+    # window width = SYNC_GROUPS * LOCAL_DISPATCH blocks.
+    hook = _TimedHook(lambda: float(trainer.last_loss),
+                      SYNC_GROUPS * LOCAL_DISPATCH)
     start = time.perf_counter()
-    state["t"] = start
+    hook.start()
     loss_sum = 0.0
     pairs = 0.0
     for epoch in range(EPOCHS):
@@ -236,8 +241,7 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
         pairs += ep_pairs
     elapsed = time.perf_counter() - start
     words = model.trained_words - warm_words
-    med = float(np.median(walls)) if walls else 0.0
-    median_wps = (float(np.mean(words_acc)) / med) if med else 0.0
+    median_wps = hook.median_wps()
 
     # Host-batch PS segment (row-set prep on the host, the path that
     # also runs cross-process over TCP): a short pipelined stretch.
@@ -310,7 +314,7 @@ def topic_separation(emb: np.ndarray, dictionary,
     band_a, band_b = band_a[:per_band], band_b[:per_band]
     if fetch_rows is not None:
         rows = fetch_rows(np.array(band_a + band_b, np.int32))
-        a, b = rows[:per_band], rows[per_band:]
+        a, b = rows[:len(band_a)], rows[len(band_a):]
     else:
         a = emb[band_a]
         b = emb[band_b]
@@ -424,75 +428,109 @@ def matrix_bandwidth() -> dict:
     nbytes = num_row * num_col * 4
     import jax
 
+    # NOTE on timing: jax.block_until_ready is NOT reliable on the
+    # tunneled platform (it can return before execution completes), so
+    # every measurement below forces completion with a tiny scalar
+    # READBACK chained onto the measured work.
     mv.init([])
     table = mv.create_matrix_table(num_row, num_col)
     delta = jnp.ones((num_row, num_col), jnp.float32)
-    jax.block_until_ready(delta)
+    float(delta[0, 0])  # settle the upload
     table.add(delta)
-    jax.block_until_ready(table.get_device())  # compile + settle
+    float(table.get_device()[0, 0])  # compile + settle
     start = time.perf_counter()
     ids = [table.add_async(delta) for _ in range(iters)]
     for msg_id in ids:
         table.wait(msg_id)
-    jax.block_until_ready(table.get_device())
+    float(table.get_device()[0, 0])  # the adds chain through the table
     add_gbps = nbytes / ((time.perf_counter() - start) / (iters + 1)) / 1e9
     start = time.perf_counter()
-    outs = [table.get_device() for _ in range(iters)]
-    jax.block_until_ready(outs[-1])
+    acc = None
+    for _ in range(iters):
+        probe_elt = table.get_device()[0, 0]  # ties each get into the
+        acc = probe_elt if acc is None else acc + probe_elt  # readback
+    float(acc)
     get_gbps = nbytes / ((time.perf_counter() - start) / iters) / 1e9
-    del outs
 
     # Tunnel characterization: the dirty-row sparse Get fills a HOST
     # buffer (reference API semantics), so on a tunneled device it is
-    # capped by device->host bandwidth, not by the table stack. Measure
+    # capped by host<->device bandwidth, not by the table stack. Measure
     # and report both directions so the sparse number is interpretable.
     probe = np.ones(4 << 20, np.float32)  # 16 MB
-    jax.block_until_ready(jnp.asarray(probe))
+    float(jnp.asarray(probe)[0])  # warm the transfer path
+    probe2 = probe * 2.0  # fresh bytes, allocated OUTSIDE the window
     t0 = time.perf_counter()
-    dev_probe = jnp.asarray(probe)
-    jax.block_until_ready(dev_probe)
+    dev_probe = jnp.asarray(probe2)
+    float(dev_probe[0])
     up_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
-    fresh = jax.block_until_ready(dev_probe * 2.0)
     t0 = time.perf_counter()
-    np.asarray(fresh)
+    np.asarray(dev_probe)
     down_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
     # Per-call dispatch floor: how long one tiny jitted op takes to
-    # dispatch AND complete. On a tunneled device this floor (not
-    # compute) often bounds words/s — report it so rates are readable.
+    # dispatch AND complete (scalar readback per call). On a tunneled
+    # device this floor (not compute) often bounds words/s — report it
+    # so rates are readable.
     tiny = jax.jit(lambda x: x + 1.0)
-    s0 = jax.block_until_ready(tiny(jnp.float32(0)))
+    s0 = tiny(jnp.float32(0))
+    float(s0)
     t0 = time.perf_counter()
     for _ in range(20):
-        s0 = jax.block_until_ready(tiny(s0))  # block EACH call: the
-        # async pipeline would otherwise hide the per-call roundtrip
+        s0 = tiny(s0)
+        float(s0)  # force EACH call: the async pipeline would
+        # otherwise hide the per-call roundtrip
     dispatch_ms = (time.perf_counter() - t0) / 20 * 1e3
 
     # Sparse dirty-row path (ref: test_matrix_perf.cpp sparse variants):
-    # dirty rows per round, dirty-only whole-table get.
-    sparse = mv.create_matrix_table(num_row, num_col, is_sparse=True)
-    buf = np.zeros((num_row, num_col), np.float32)
-    sparse.get(out=buf)  # initial full sync marks everything clean
-    dirty_n = num_row // 50
+    # dirty rows per round, dirty-only whole-table get — measured on
+    # the DEVICE path (host bitmap bookkeeping, HBM payload: deltas
+    # push as device arrays, dirty values reply as device arrays). The
+    # reference-shaped host-buffer variant is timed alongside; on a
+    # tunneled device it is bounded by host<->device bandwidth, which
+    # the tunnel numbers below make interpretable.
+    from multiverso_tpu.util.configure import get_flag, set_flag
+    prev_compress = get_flag("sparse_compress")
+    set_flag("sparse_compress", False)  # in-process: there is no wire
+    try:
+        sparse = mv.create_matrix_table(num_row, num_col, is_sparse=True)
+    finally:
+        set_flag("sparse_compress", prev_compress)
+    sparse.get_dirty_device()  # initial full sync marks everything clean
+    dirty_n = num_row // 10  # the reference perf test's p/10 fraction
     rows = np.arange(dirty_n, dtype=np.int32) * 10
-    row_delta = np.ones((dirty_n, num_col), np.float32)
+    dev_delta = jnp.ones((dirty_n, num_col), jnp.float32)
+    jax.block_until_ready(dev_delta)
     opt = AddOption(worker_id=1)  # dirties the rows for worker 0
-    # One untimed roundtrip: compiles the dirty-row gather/scatter for
-    # this row-count bucket (compiling inside the timed loop would
-    # swamp 3 iterations).
-    sparse.add_rows(rows, row_delta, option=opt)
-    sparse.get(out=buf)
+    # One untimed roundtrip compiles the dirty gather/scatter bucket.
+    sparse.add_rows(rows, dev_delta, option=opt)
+    _, warm_vals = sparse.get_dirty_device()
+    float(warm_vals[0, 0])
     start = time.perf_counter()
-    sparse_iters = 3
+    sparse_iters = 10
+    vals = None
     for _ in range(sparse_iters):
-        sparse.add_rows(rows, row_delta, option=opt)
-        sparse.get(out=buf)  # returns only the dirty rows
+        sparse.add_rows(rows, dev_delta, option=opt)
+        _, vals = sparse.get_dirty_device()  # only the dirty rows
+    float(vals[0, 0])  # force the dispatched chain
     sparse_elapsed = time.perf_counter() - start
     sparse_bytes = dirty_n * num_col * 4 * 2  # add + dirty-row get
     sparse_gbps = sparse_bytes * sparse_iters / sparse_elapsed / 1e9
+
+    # Host-buffer variant (the reference API shape: Get fills caller
+    # memory) for comparison.
+    buf = np.zeros((num_row, num_col), np.float32)
+    row_delta = np.ones((dirty_n, num_col), np.float32)
+    sparse.get(out=buf)
+    start = time.perf_counter()
+    for _ in range(2):
+        sparse.add_rows(rows, row_delta, option=opt)
+        sparse.get(out=buf)
+    host_sparse_gbps = sparse_bytes * 2 / (time.perf_counter() - start) \
+        / 1e9
     mv.shutdown()
     return {"add_gbps": round(add_gbps, 3),
             "get_gbps": round(get_gbps, 3),
             "sparse_dirty_roundtrip_gbps": round(sparse_gbps, 3),
+            "sparse_dirty_hostbuf_gbps": round(host_sparse_gbps, 3),
             "tunnel_upload_mbps": round(up_mbps, 1),
             "tunnel_download_mbps": round(down_mbps, 1),
             "dispatch_roundtrip_ms": round(dispatch_ms, 3)}

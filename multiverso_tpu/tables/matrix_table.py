@@ -57,6 +57,10 @@ define_bool("one_bit_push", False,
             "empty OneBitsFilter stub (quantization_util.h:160-161)")
 
 _ALL_KEY = np.array([-1], dtype=np.int32)
+# Sentinel -2: whole-table dirty get with a DEVICE-resident reply
+# (in-process extension; -1 keeps the reference's host-reply semantics,
+# ref: matrix_table.cpp:267-276 sentinel handling).
+_ALL_KEY_DEVICE_REPLY = np.array([-2], dtype=np.int32)
 
 
 def _onebit_blobs(chunk: np.ndarray):
@@ -171,6 +175,7 @@ class MatrixWorker(WorkerTable):
         self._dest: Optional[np.ndarray] = None
         self._dest_rows: Optional[np.ndarray] = None  # requested row-id vector
         self._device_shards: Optional[Dict[int, object]] = None
+        self._device_shard_ids: Optional[Dict[int, np.ndarray]] = None
 
     # -- Get API (ref: matrix_table.cpp:58-105) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -364,7 +369,7 @@ class MatrixWorker(WorkerTable):
             return {0: list(blobs)}
         keys = blobs[0].as_array(np.int32)
         out: Dict[int, List[Blob]] = {}
-        if keys.size == 1 and keys[0] == -1:
+        if keys.size == 1 and keys[0] < 0:  # -1 / -2 whole-table sentinels
             is_add = msg_type == MsgType.Request_Add
             compress = is_add and self._compress
             values = blobs[1].typed(self.dtype) if is_add else None
@@ -438,6 +443,30 @@ class MatrixWorker(WorkerTable):
             out[int(sid)] = shard
         return out
 
+    def get_dirty_device(self):
+        """Sparse dirty-row pull with a DEVICE-resident reply: returns
+        ``(row_ids, values)`` where values is a ``jax.Array`` in HBM —
+        the staleness bookkeeping stays host-side (it is a bitmap), but
+        the row payload never crosses the host boundary. This is the
+        TPU-native form of the reference's dirty-only Get
+        (ref: sparse_matrix_table.cpp:226-258), whose host-buffer reply
+        is otherwise bounded by host<->device bandwidth."""
+        CHECK(self.is_sparse, "dirty gets are for sparse tables")
+        CHECK(self._num_server == 1 and self._zoo.net.in_process,
+              "device dirty gets need an in-process single server")
+        self._dest, self._dest_rows = None, None
+        self._device_shards = {}
+        self._device_shard_ids = {}
+        self.wait(self._request_get(
+            Blob(_ALL_KEY_DEVICE_REPLY.view(np.uint8))))
+        shards, ids = self._device_shards, self._device_shard_ids
+        self._device_shards, self._device_shard_ids = None, None
+        order = sorted(shards)
+        values = shards[order[0]] if len(order) == 1 else None
+        row_ids = np.concatenate([ids[s] for s in order]) if order \
+            else np.zeros(0, np.int32)
+        return row_ids, values
+
     # -- device-resident whole-table Get (shards stay in HBM) --
     def get_device(self):
         CHECK(not self.is_sparse,
@@ -472,11 +501,16 @@ class MatrixWorker(WorkerTable):
         if self._device_shards is not None:
             # Device row pull: keep the server's gather result in HBM,
             # keyed by the owning server (a shard carries one server's
-            # contiguous key segment).
-            sid = int(min(keys[0] // self._row_length,
-                          self._num_server - 1))
+            # contiguous key segment). The dirty-device flow
+            # additionally records the reply's row ids (and may reply
+            # zero rows).
+            sid = 0 if keys.size == 0 else \
+                int(min(keys[0] // self._row_length,
+                        self._num_server - 1))
             self._device_shards[sid] = _shaped_rows(
                 reply_blobs[1].typed(self.dtype), keys.size, self.num_col)
+            if self._device_shard_ids is not None:
+                self._device_shard_ids[sid] = keys
             return
         if self._compress and len(reply_blobs) == 3:
             values = _decompress_values(
@@ -630,16 +664,21 @@ class MatrixServer(ServerTable):
     # -- Get (ref: matrix_table.cpp:420-454, sparse_matrix_table.cpp:226-309)
     def process_get(self, blobs: List[Blob]) -> List[Blob]:
         if blobs[0].on_device:
-            # Device-key gather: reply values shaped ids.shape + (C,),
-            # all in HBM. Dense tables only (sparse staleness marks
-            # need host ids).
+            # Dense device-key gather: reply values shaped
+            # ids.shape + (C,), all in HBM.
             CHECK(self._up_to_date is None,
-                  "device-key gets are for dense tables")
+                  "device-key gets are for dense tables (sparse dirty "
+                  "gets use the -2 host sentinel)")
             rows = blobs[0].typed(np.int32)
             if self.row_offset:
                 rows = rows - self.row_offset
             return [blobs[0], Blob(self._gather(self._data, rows))]
         keys = blobs[0].as_array(np.int32)
+        if keys.size == 1 and keys[0] == -2:
+            CHECK(self._up_to_date is not None and len(blobs) >= 2,
+                  "-2 sentinel is the sparse dirty device-reply get")
+            return self._sparse_get_all_device(
+                GetOption.from_blob(blobs[1]))
         if keys.size == 1 and keys[0] == -1:
             if self._up_to_date is not None and len(blobs) >= 2:
                 return self._sparse_get_all(GetOption.from_blob(blobs[1]))
@@ -665,6 +704,16 @@ class MatrixServer(ServerTable):
     def _sparse_get_all(self, opt: GetOption) -> List[Blob]:
         """Return only this worker's dirty rows
         (ref: sparse_matrix_table.cpp:226-258)."""
+        dirty, values = self._dirty_rows(opt)
+        return [Blob(dirty + self.row_offset)] + self._reply_values(values)
+
+    def _sparse_get_all_device(self, opt: GetOption) -> List[Blob]:
+        """Dirty rows with the values left in HBM (host ids, device
+        payload; no wire filter — this path never crosses a wire)."""
+        dirty, values = self._dirty_rows(opt)
+        return [Blob(dirty + self.row_offset), Blob(values)]
+
+    def _dirty_rows(self, opt: GetOption):
         wid = opt.worker_id
         CHECK(0 <= wid < self._up_to_date.shape[0], "bad worker id")
         dirty = np.nonzero(~self._up_to_date[wid])[0].astype(np.int32)
@@ -672,7 +721,7 @@ class MatrixServer(ServerTable):
         padded_rows = pad_ids(dirty, self._data.shape[0])
         values = _trim_rows(self._gather(self._data, padded_rows),
                             dirty.size)
-        return [Blob(dirty + self.row_offset)] + self._reply_values(values)
+        return dirty, values
 
     @functools.cached_property
     def _gather(self):

@@ -305,6 +305,30 @@ class TestDeviceResidentPath:
         np.testing.assert_array_equal(got[2], base[31] + 1)
         np.testing.assert_array_equal(got[3], base[7] + 2)
 
+    def test_sparse_dirty_device_roundtrip(self, env):
+        # Device-reply dirty gets: same staleness semantics as the host
+        # path (ref: sparse_matrix_table.cpp:226-258), payload in HBM.
+        import jax.numpy as jnp
+        from multiverso_tpu.util.configure import get_flag, set_flag
+        prev = get_flag("sparse_compress")
+        set_flag("sparse_compress", False)  # in-process: no wire
+        try:
+            table = mv.create_matrix_table(16, 4, is_sparse=True)
+        finally:
+            set_flag("sparse_compress", prev)
+        ids0, vals0 = table.get_dirty_device()  # initial: all dirty
+        assert ids0.size == 16 and vals0.shape == (16, 4)
+        rows = np.array([2, 9], np.int32)
+        table.add_rows(rows, jnp.ones((2, 4), jnp.float32),
+                       option=AddOption(worker_id=1))
+        ids, vals = table.get_dirty_device()
+        assert hasattr(vals, "addressable_shards")
+        np.testing.assert_array_equal(ids, rows)
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      np.ones((2, 4), np.float32))
+        ids2, _ = table.get_dirty_device()  # now clean
+        assert ids2.size == 0
+
     def test_matrix_device_keys_rejected_multi_server(self):
         def body(rank):
             import jax.numpy as jnp
