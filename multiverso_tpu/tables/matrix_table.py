@@ -156,8 +156,13 @@ class MatrixWorker(WorkerTable):
         self.is_sparse = bool(is_sparse)
         # Wire compression for sparse traffic, both directions, as the
         # reference does unconditionally (sparse_matrix_table.cpp:148-153);
-        # here behind a flag read at table-construction time.
-        self._compress = self.is_sparse and bool(get_flag("sparse_compress"))
+        # here behind a flag read at table-construction time — and only
+        # when there IS a wire: an in-process fabric moves object
+        # references, so filtering would only burn CPU and force device
+        # payloads through host bytes.
+        self._compress = (self.is_sparse
+                          and not self._zoo.net.in_process
+                          and bool(get_flag("sparse_compress")))
         # 1-bit push quantization (dense float32 tables; sparse traffic
         # already rides SparseFilter). Pulls stay full precision — only
         # gradient pushes quantize. The worker-side error-feedback buffer
@@ -461,11 +466,8 @@ class MatrixWorker(WorkerTable):
             Blob(_ALL_KEY_DEVICE_REPLY.view(np.uint8))))
         shards, ids = self._device_shards, self._device_shard_ids
         self._device_shards, self._device_shard_ids = None, None
-        order = sorted(shards)
-        values = shards[order[0]] if len(order) == 1 else None
-        row_ids = np.concatenate([ids[s] for s in order]) if order \
-            else np.zeros(0, np.int32)
-        return row_ids, values
+        CHECK(len(shards) == 1, "single-server dirty get: one reply")
+        return ids[0], shards[0]
 
     # -- device-resident whole-table Get (shards stay in HBM) --
     def get_device(self):
@@ -548,7 +550,9 @@ class MatrixServer(ServerTable):
         self.dtype = np.dtype(dtype)
         self.num_col = int(num_col)
         self.is_sparse = bool(is_sparse)
-        self._compress = self.is_sparse and bool(get_flag("sparse_compress"))
+        self._compress = (self.is_sparse
+                          and not self._zoo.net.in_process
+                          and bool(get_flag("sparse_compress")))
         self._one_bit = (not self.is_sparse
                          and np.dtype(dtype) == np.float32
                          and bool(get_flag("one_bit_push")))

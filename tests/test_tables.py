@@ -177,11 +177,15 @@ class TestSparseMatrix:
     def test_wire_compression_roundtrip_and_shrink(self, env):
         # Sparse traffic runs through SparseFilter both directions
         # (ref: sparse_matrix_table.cpp:148-153): a mostly-zero row delta
-        # must round-trip exactly AND shrink on the wire.
+        # must round-trip exactly AND shrink on the wire. In-process
+        # tables skip the filter automatically (no wire), so force it on
+        # both endpoints to exercise the cross-process machinery.
         from multiverso_tpu.core.message import MsgType
 
         cols = 64
         table = mv.create_matrix_table(8, cols, is_sparse=True)
+        table._compress = True
+        mv.current_zoo()._server_tables[table.table_id]._compress = True
         table.get()  # clean all for worker 0
         delta = np.zeros((2, cols), np.float32)
         delta[0, 3] = 7.0
@@ -309,13 +313,7 @@ class TestDeviceResidentPath:
         # Device-reply dirty gets: same staleness semantics as the host
         # path (ref: sparse_matrix_table.cpp:226-258), payload in HBM.
         import jax.numpy as jnp
-        from multiverso_tpu.util.configure import get_flag, set_flag
-        prev = get_flag("sparse_compress")
-        set_flag("sparse_compress", False)  # in-process: no wire
-        try:
-            table = mv.create_matrix_table(16, 4, is_sparse=True)
-        finally:
-            set_flag("sparse_compress", prev)
+        table = mv.create_matrix_table(16, 4, is_sparse=True)
         ids0, vals0 = table.get_dirty_device()  # initial: all dirty
         assert ids0.size == 16 and vals0.shape == (16, 4)
         rows = np.array([2, 9], np.int32)

@@ -213,6 +213,44 @@ class TestDeviceCorpusTrainer:
             assert model.trained_words == pytest.approx(tok.flat.size)
         assert pair_counts[1e-4] < 0.7 * pair_counts[0]
 
+    def test_device_pipeline_max_steps_and_accounting(self, tmp_path):
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=100)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        model = Word2Vec(Word2VecConfig(embedding_size=8, window=2,
+                                        epochs=1, batch_size=128,
+                                        sample=0), d)
+        trainer = DeviceCorpusTrainer(model, tok, centers_per_step=64,
+                                      steps_per_dispatch=4)
+        # A truncated (warmup-style) epoch trains only max_steps steps.
+        _, pairs = trainer.train_epoch(seed=0, max_steps=2)
+        assert 0 < pairs < tok.flat.size * 4  # a fraction of the epoch
+        assert trainer.kept_words_trained == 2 * 64
+        # lr clock advanced proportionally, not a full epoch.
+        assert 0 < model.trained_words < tok.flat.size
+
+    def test_device_pipeline_group_hook_words_sum(self, tmp_path):
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=100)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        model = Word2Vec(Word2VecConfig(embedding_size=8, window=2,
+                                        epochs=1, batch_size=128,
+                                        sample=0), d)
+        trainer = DeviceCorpusTrainer(model, tok, centers_per_step=64,
+                                      steps_per_dispatch=4)
+        seen = []
+        trainer.train_epoch(seed=0, group_hook=seen.append)
+        # Hook word counts must sum to exactly the epoch's raw words
+        # (the words/sec denominators depend on it).
+        assert sum(seen) == pytest.approx(tok.flat.size)
+        assert model.trained_words == pytest.approx(tok.flat.size)
+
     def test_device_pipeline_rejects_cbow_hs(self, tmp_path):
         from multiverso_tpu.models.wordembedding import (
             DeviceCorpusTrainer, TokenizedCorpus)
