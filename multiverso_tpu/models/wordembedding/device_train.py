@@ -115,16 +115,52 @@ def _sgns_loss_and_grads(v, u_ctx, u_neg, pmask):
     return (loss,) + grads
 
 
+def _cbow_loss_and_grads(u_ctx, u_out, pmask):
+    """CBOW objective over gathered rows: the masked mean of the
+    window's INPUT rows predicts [center | K negatives] from the OUTPUT
+    table — one example per center (ref: wordembedding.cpp CBOW
+    branch; gradient through the mean is the mathematically consistent
+    1/|window| form, as on the host-batch path). ``u_ctx`` [C, 2W, D],
+    ``u_out`` [C, 1+K, D]. Returns (loss, g_ctx, g_out, examples)."""
+    nvalid = pmask.sum(axis=1)
+    has_ctx = (nvalid > 0).astype(jnp.float32)
+    k = u_out.shape[1] - 1
+
+    def loss_fn(u_ctx, u_out):
+        denom = jnp.maximum(nvalid, 1.0)
+        v = (u_ctx * pmask[..., None]).sum(axis=1) / denom[:, None]
+        logits = jnp.clip(jnp.einsum("cd,csd->cs", v, u_out),
+                          -_MAX_EXP, _MAX_EXP)
+        labels = jnp.concatenate(
+            [jnp.ones((1, 1)), jnp.zeros((1, k))], axis=1)
+        return jnp.sum(_sigmoid_xent(logits, labels)
+                       * has_ctx[:, None])
+
+    loss, (g_ctx, g_out) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1))(u_ctx, u_out)
+    return loss, g_ctx, g_out, has_ctx.sum()
+
+
 # Module-level cache so every trainer instance with the same static
-# shape (C, window, negative, corpus length) shares one compiled group
-# program — a warmup trainer's compile pays for the timed one.
+# shape (C, window, negative, corpus length, mode) shares one compiled
+# group program — a warmup trainer's compile pays for the timed one.
 @functools.lru_cache(maxsize=None)
-def _group_fn(C: int, W: int, K: int, n: int):
+def _group_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
     def step(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
              key, base, lr, n_kept):
         centers, ctx, negs, pmask = _window_and_negs(
             C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base,
             n_kept)
+        if cbow:
+            # window (input table) -> [center | negs] (output table)
+            u_ctx = emb_in[ctx]                       # [C, 2W, D]
+            out_ids = jnp.concatenate([centers[:, None], negs], axis=1)
+            u_out = emb_out[out_ids]                  # [C, 1+K, D]
+            loss, g_ctx, g_out, examples = _cbow_loss_and_grads(
+                u_ctx, u_out, pmask)
+            emb_in = emb_in.at[ctx].add(-lr * g_ctx)
+            emb_out = emb_out.at[out_ids].add(-lr * g_out)
+            return emb_in, emb_out, loss, examples
         v = emb_in[centers]          # [C, D]
         u_ctx = emb_out[ctx]         # [C, 2W, D]
         u_neg = emb_out[negs]        # [C, K, D]
@@ -162,9 +198,10 @@ class _CorpusOnDevice:
 
     def __init__(self, model, tokenized: TokenizedCorpus):
         config = model.config
-        if config.cbow or config.hs:
-            raise ValueError("device corpus training covers skip-gram "
-                             "SGNS; use the batch path for cbow/hs")
+        if config.hs:
+            raise ValueError("device corpus training covers negative "
+                             "sampling (skip-gram + CBOW); hierarchical "
+                             "softmax stays on the batch path")
         flat = np.asarray(tokenized.flat, np.int32)
         lengths = np.diff(tokenized.offsets).astype(np.int64)
         sent = np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
@@ -180,9 +217,9 @@ class _CorpusOnDevice:
 
 class DeviceCorpusTrainer:
     """Drives a ``Word2Vec`` model's embeddings straight from a
-    device-resident ``TokenizedCorpus``. Skip-gram + negative sampling
-    (the reference's default and the bench headline); CBOW/HS stay on
-    the general host-batch path."""
+    device-resident ``TokenizedCorpus``. Negative sampling in both
+    skip-gram (the reference's default and the bench headline) and CBOW
+    modes; hierarchical softmax stays on the general host-batch path."""
 
     def __init__(self, model, tokenized: TokenizedCorpus,
                  centers_per_step: int = 32768,
@@ -195,7 +232,7 @@ class DeviceCorpusTrainer:
         self._corpus = _CorpusOnDevice(model, tokenized)
         self._n_tokens = self._corpus.n_tokens
         self._group = _group_fn(self._C, config.window, config.negative,
-                                self._n_tokens)
+                                self._n_tokens, bool(config.cbow))
         # Post-subsampling tokens actually trained (centers), across
         # epochs — the exact basis for utilization accounting.
         self.kept_words_trained = 0
@@ -205,8 +242,10 @@ class DeviceCorpusTrainer:
         """One full epoch on device. ``group_hook(words)`` is called
         after each dispatched group with the raw-word count it covered
         (bench timing); ``max_steps`` truncates the epoch (warmup).
-        Returns (loss_sum, pair_count) as floats — fetched ONCE at
-        epoch end."""
+        Returns (loss_sum, examples) as floats — fetched ONCE at epoch
+        end. ``examples`` counts (center, context) pairs in skip-gram
+        mode and trained centers in CBOW mode (one prediction per
+        center)."""
         model, C, G = self.model, self._C, self._G
         key = jax.random.PRNGKey(seed)
         key, prep_key = jax.random.split(key)
@@ -244,27 +283,39 @@ class DeviceCorpusTrainer:
 
 
 @functools.lru_cache(maxsize=None)
-def _block_ids_fn(C: int, W: int, K: int, n: int):
-    """Jitted block preparation for the PS pipeline: centers, the fused
-    output id block [ctx | negatives], and the pair validity mask — all
-    device-resident, ready to hand to the tables as DEVICE keys."""
+def _block_ids_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
+    """Jitted block preparation for the PS pipeline: the INPUT-table id
+    block, the OUTPUT-table id block, and the pair validity mask — all
+    device-resident, ready to hand to the tables as DEVICE keys.
+    Skip-gram: in=centers [C], out=[ctx | negs] [C, 2W+K].
+    CBOW: in=ctx [C, 2W], out=[center | negs] [C, 1+K]."""
 
     def ids(kept, ksent, neg_prob, neg_alias, key, base, n_kept):
         centers, ctx, negs, pmask = _window_and_negs(
             C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base,
             n_kept)
+        if cbow:
+            return ctx, jnp.concatenate([centers[:, None], negs],
+                                        axis=1), pmask
         return centers, jnp.concatenate([ctx, negs], axis=1), pmask
 
     return jax.jit(ids)
 
 
 @functools.lru_cache(maxsize=None)
-def _block_step_fn(C: int, W: int, K: int):
+def _block_step_fn(C: int, W: int, K: int, cbow: bool = False):
     """Jitted PS block step over PULLED rows: returns the PUSH deltas
     ``-lr*grad/num_workers`` (the reference's (new-old)/num_workers with
-    one local step, ref: communicator.cpp:157-249) plus loss/pairs."""
+    one local step, ref: communicator.cpp:157-249) plus loss/examples."""
 
     def step(v, u, pmask, lr_scaled):
+        if cbow:
+            # v = pulled INPUT window rows [C, 2W, D]; u = pulled OUTPUT
+            # [center | negs] rows [C, 1+K, D].
+            loss, g_ctx, g_out, examples = _cbow_loss_and_grads(
+                v, u, pmask)
+            return (-lr_scaled * g_ctx, -lr_scaled * g_out, loss,
+                    examples)
         loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
             v, u[:, :2 * W], u[:, 2 * W:], pmask)
         g_u = jnp.concatenate([g_ctx, g_neg], axis=1)
@@ -309,9 +360,10 @@ class PSDeviceCorpusTrainer:
             model._neg_prob_dev = jnp.asarray(model._neg_prob_host)
             model._neg_alias_dev = jnp.asarray(model._neg_alias_host)
         self._ids = _block_ids_fn(self._C, config.window,
-                                  config.negative, self._n_tokens)
+                                  config.negative, self._n_tokens,
+                                  bool(config.cbow))
         self._step = _block_step_fn(self._C, config.window,
-                                    config.negative)
+                                    config.negative, bool(config.cbow))
         self.kept_words_trained = 0
 
     def train_epoch(self, seed: int, block_hook=None,
@@ -335,12 +387,15 @@ class PSDeviceCorpusTrainer:
         pair_acc = None
         for s in range(steps):
             step_key = jax.random.fold_in(key, s)
-            centers, out_ids, pmask = self._ids(
+            # in_ids: centers [C] (skip-gram) or the context window
+            # block [C, 2W] (CBOW); out_ids: [ctx | negs] or
+            # [center | negs] — see _block_ids_fn.
+            in_ids, out_ids, pmask = self._ids(
                 kept, ksent, model._neg_prob_dev, model._neg_alias_dev,
                 step_key, np.int32(s * C), n_kept_dev)
             # Device-key pulls ride the worker->server actor round trip;
             # the replies are lazy device arrays (no host sync).
-            mid_in = in_table.get_rows_device_async(centers)
+            mid_in = in_table.get_rows_device_async(in_ids)
             mid_out = out_table.get_rows_device_async(out_ids)
             in_table.wait(mid_in)
             out_table.wait(mid_out)
@@ -352,7 +407,7 @@ class PSDeviceCorpusTrainer:
             # Fire-and-forget pushes: waiters self-reap on ack; the
             # trailing drain below bounds the epoch.
             model._pending_pushes.append(
-                (in_table, in_table.add_rows_async(centers, d_v)))
+                (in_table, in_table.add_rows_async(in_ids, d_v)))
             model._pending_pushes.append(
                 (out_table, out_table.add_rows_async(out_ids, d_u)))
             model._account_words(raw_per_step)

@@ -251,14 +251,36 @@ class TestDeviceCorpusTrainer:
         assert sum(seen) == pytest.approx(tok.flat.size)
         assert model.trained_words == pytest.approx(tok.flat.size)
 
-    def test_device_pipeline_rejects_cbow_hs(self, tmp_path):
+    def test_device_pipeline_cbow_separates_topics(self, tmp_path):
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        config = Word2VecConfig(embedding_size=16, window=3, epochs=3,
+                                init_learning_rate=0.02, batch_size=1024,
+                                sample=0, cbow=True)
+        model = Word2Vec(config, d)
+        trainer = DeviceCorpusTrainer(model, tok, centers_per_step=128,
+                                      steps_per_dispatch=4)
+        losses = []
+        for epoch in range(3):
+            loss, examples = trainer.train_epoch(seed=epoch)
+            losses.append(loss / max(examples, 1))
+        assert losses[-1] < losses[0], losses
+        sep = topic_separation(model, d)
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_device_pipeline_rejects_hs(self, tmp_path):
         from multiverso_tpu.models.wordembedding import (
             DeviceCorpusTrainer, TokenizedCorpus)
         path = tmp_path / "corpus.txt"
         write_topic_corpus(path, n_sentences=20)
         d = Dictionary.build(str(path), min_count=1)
         tok = TokenizedCorpus.build(d, str(path))
-        model = Word2Vec(Word2VecConfig(embedding_size=8, cbow=True), d)
+        model = Word2Vec(Word2VecConfig(embedding_size=8, hs=True,
+                                        negative=0), d)
         with pytest.raises(ValueError):
             DeviceCorpusTrainer(model, tok)
 
@@ -292,6 +314,61 @@ class TestPSDevicePipeline:
             assert sep > 0.3, f"separation {sep}"
         finally:
             mv.shutdown()
+
+
+    def test_ps_device_pipeline_cbow(self, tmp_path):
+        from multiverso_tpu.models.wordembedding import (
+            PSDeviceCorpusTrainer, PSWord2Vec, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        mv.init([])
+        try:
+            config = Word2VecConfig(embedding_size=16, window=3,
+                                    epochs=3, init_learning_rate=0.02,
+                                    batch_size=1024, sample=0, cbow=True)
+            model = PSWord2Vec(config, d)
+            trainer = PSDeviceCorpusTrainer(model, tok,
+                                            centers_per_step=128)
+            losses = []
+            for epoch in range(3):
+                loss, examples = trainer.train_epoch(seed=epoch)
+                losses.append(loss / max(examples, 1))
+            assert losses[-1] < losses[0], losses
+            sep = topic_separation(model, d)
+            assert sep > 0.3, f"separation {sep}"
+        finally:
+            mv.shutdown()
+
+    def test_ps_device_pipeline_two_workers(self, tmp_path):
+        # Two virtual worker ranks drive the device-key PS pipeline
+        # against one shared server (device keys need a single server):
+        # delta scaling 1/num_workers, interleaved device-key
+        # pulls/pushes through one device.
+        from multiverso_tpu.models.wordembedding import (
+            PSDeviceCorpusTrainer, PSWord2Vec, TokenizedCorpus)
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+
+        def body(rank):
+            config = Word2VecConfig(embedding_size=16, window=3,
+                                    epochs=3, init_learning_rate=0.01,
+                                    batch_size=1024, sample=0)
+            model = PSWord2Vec(config, d)
+            for epoch in range(3):
+                loss, pairs = PSDeviceCorpusTrainer(
+                    model, tok, centers_per_step=128).train_epoch(
+                        seed=100 * rank + epoch)
+                assert np.isfinite(loss) and pairs > 0
+            mv.current_zoo().barrier()
+            return topic_separation(model, d)
+
+        seps = LocalCluster(2, roles=["all", "worker"]).run(body)
+        assert all(s > 0.3 for s in seps), seps
 
 
 class TestBatchGroup:
