@@ -141,6 +141,37 @@ def _cbow_loss_and_grads(u_ctx, u_out, pmask):
     return loss, g_ctx, g_out, has_ctx.sum()
 
 
+def _apply_step(C, W, K, n, cbow, emb_in, emb_out, kept, ksent,
+                neg_prob, neg_alias, key, base, lr, n_kept):
+    """One full in-jit training step against local table arrays —
+    window former + objective + the two scatter-add updates. Shared by
+    the single-device group scan and the MA (model-average) mesh path
+    so the update math cannot diverge between them. Returns
+    (emb_in, emb_out, loss, examples)."""
+    centers, ctx, negs, pmask = _window_and_negs(
+        C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base, n_kept)
+    if cbow:
+        # window (input table) -> [center | negs] (output table)
+        u_ctx = emb_in[ctx]                       # [C, 2W, D]
+        out_ids = jnp.concatenate([centers[:, None], negs], axis=1)
+        u_out = emb_out[out_ids]                  # [C, 1+K, D]
+        loss, g_ctx, g_out, examples = _cbow_loss_and_grads(
+            u_ctx, u_out, pmask)
+        emb_in = emb_in.at[ctx].add(-lr * g_ctx)
+        emb_out = emb_out.at[out_ids].add(-lr * g_out)
+        return emb_in, emb_out, loss, examples
+    v = emb_in[centers]          # [C, D]
+    u_ctx = emb_out[ctx]         # [C, 2W, D]
+    u_neg = emb_out[negs]        # [C, K, D]
+    loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
+        v, u_ctx, u_neg, pmask)
+    emb_in = emb_in.at[centers].add(-lr * g_v)
+    out_ids = jnp.concatenate([ctx, negs], axis=1)
+    g_out = jnp.concatenate([g_ctx, g_neg], axis=1)
+    emb_out = emb_out.at[out_ids].add(-lr * g_out)
+    return emb_in, emb_out, loss, pmask.sum()
+
+
 # Module-level cache so every trainer instance with the same static
 # shape (C, window, negative, corpus length, mode) shares one compiled
 # group program — a warmup trainer's compile pays for the timed one.
@@ -148,29 +179,9 @@ def _cbow_loss_and_grads(u_ctx, u_out, pmask):
 def _group_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
     def step(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
              key, base, lr, n_kept):
-        centers, ctx, negs, pmask = _window_and_negs(
-            C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base,
-            n_kept)
-        if cbow:
-            # window (input table) -> [center | negs] (output table)
-            u_ctx = emb_in[ctx]                       # [C, 2W, D]
-            out_ids = jnp.concatenate([centers[:, None], negs], axis=1)
-            u_out = emb_out[out_ids]                  # [C, 1+K, D]
-            loss, g_ctx, g_out, examples = _cbow_loss_and_grads(
-                u_ctx, u_out, pmask)
-            emb_in = emb_in.at[ctx].add(-lr * g_ctx)
-            emb_out = emb_out.at[out_ids].add(-lr * g_out)
-            return emb_in, emb_out, loss, examples
-        v = emb_in[centers]          # [C, D]
-        u_ctx = emb_out[ctx]         # [C, 2W, D]
-        u_neg = emb_out[negs]        # [C, K, D]
-        loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
-            v, u_ctx, u_neg, pmask)
-        emb_in = emb_in.at[centers].add(-lr * g_v)
-        out_ids = jnp.concatenate([ctx, negs], axis=1)
-        g_out = jnp.concatenate([g_ctx, g_neg], axis=1)
-        emb_out = emb_out.at[out_ids].add(-lr * g_out)
-        return emb_in, emb_out, loss, pmask.sum()
+        return _apply_step(C, W, K, n, cbow, emb_in, emb_out, kept,
+                           ksent, neg_prob, neg_alias, key, base, lr,
+                           n_kept)
 
     def group(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
               key, bases, lrs, n_kept):
@@ -188,6 +199,66 @@ def _group_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
         return emb_in, emb_out, losses.sum(), pairs.sum(), key
 
     return jax.jit(group, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _ma_group_fn(mesh, C: int, W: int, K: int, n_local: int):
+    """Model-average (``-ma``) word2vec over a device mesh: each device
+    scans G local SGNS steps against its own REPLICA of the embedding
+    tables on its own CORPUS SHARD, then the replicas average with
+    ``lax.pmean`` over ICI — the reference's MA mode (train locally,
+    MV_Aggregate; ref: src/zoo.cpp:24,49, src/multiverso.cpp:53-56)
+    with the aggregate riding XLA collectives inside one jitted step.
+
+    Arguments of the returned jit (all as ONE global call):
+    ``emb_in/emb_out`` replicated [V, D]; ``kept/ksent`` sharded
+    [n_devices * n_local]; ``keys`` one PRNG key per device
+    [n_devices, 2]; ``bases/lrs`` [G]; ``n_kept_local`` per-device kept
+    counts [n_devices]. Returns (averaged tables, summed loss, summed
+    pairs, advanced per-device keys) — feed the keys back when chaining
+    dispatches or every group replays the same draws."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+
+    def device_group(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
+                     keys, bases, lrs, n_kept_local):
+        key = keys[0]
+        n_kept = n_kept_local[0]
+        # The replicated tables DIVERGE per device once local training
+        # starts — annotate them device-varying so the scan carry types
+        # line up (pmean at the end collapses them back).
+        try:
+            pcast = functools.partial(jax.lax.pcast, to="varying")
+        except AttributeError:  # older jax spells it pvary
+            pcast = jax.lax.pvary
+        emb_in = pcast(emb_in, axis)
+        emb_out = pcast(emb_out, axis)
+
+        def body(carry, xs):
+            emb_in, emb_out, key = carry
+            base, lr = xs
+            key, sub = jax.random.split(key)
+            emb_in, emb_out, loss, pairs = _apply_step(
+                C, W, K, n_local, False, emb_in, emb_out, kept, ksent,
+                neg_prob, neg_alias, sub, base, lr, n_kept)
+            return (emb_in, emb_out, key), (loss, pairs)
+
+        (emb_in, emb_out, key), (losses, pairs) = jax.lax.scan(
+            body, (emb_in, emb_out, key), (bases, lrs))
+        # MV_Aggregate: average the trained replicas over the mesh.
+        emb_in = jax.lax.pmean(emb_in, axis)
+        emb_out = jax.lax.pmean(emb_out, axis)
+        return (emb_in, emb_out, jax.lax.psum(losses.sum(), axis),
+                jax.lax.psum(pairs.sum(), axis), key[None])
+
+    mapped = shard_map(
+        device_group, mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P(), P(),
+                  P(axis), P(), P(), P(axis)),
+        out_specs=(P(), P(), P(), P(), P(axis)))
+    return jax.jit(mapped, donate_argnums=(0, 1))
 
 
 class _CorpusOnDevice:

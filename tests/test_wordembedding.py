@@ -285,6 +285,48 @@ class TestDeviceCorpusTrainer:
             DeviceCorpusTrainer(model, tok)
 
 
+class TestMAWord2Vec:
+    def test_ma_group_trains_over_mesh(self):
+        # The reference's -ma mode on the flagship: each mesh device
+        # trains a table replica on its corpus shard, MV_Aggregate =
+        # in-jit pmean over the mesh. Replicas must come back averaged
+        # (identical) and the loss finite.
+        import jax
+        import jax.numpy as jnp
+        from multiverso_tpu.models.wordembedding.device_train import (
+            _ma_group_fn)
+        from multiverso_tpu.sharding import mesh as meshlib
+        ndev = len(jax.devices())
+        mesh = meshlib.local_mesh(ndev)
+        C, W, K, n_local, V, D, G = 64, 2, 3, 512, 40, 8, 2
+        rng = np.random.default_rng(0)
+        fn = _ma_group_fn(mesh, C, W, K, n_local)
+        emb_in = jnp.asarray(
+            (rng.random((V, D)).astype(np.float32) - 0.5) / D)
+        emb_out = jnp.zeros((V, D), jnp.float32)
+        kept = jnp.asarray(
+            rng.integers(0, V, ndev * n_local).astype(np.int32))
+        ksent = jnp.asarray(np.repeat(
+            np.arange(ndev * n_local // 16, dtype=np.int32), 16))
+        keys = jax.random.split(jax.random.PRNGKey(0), ndev)
+        bases = jnp.asarray((np.arange(G) * C).astype(np.int32))
+        lrs = jnp.full(G, 0.05, jnp.float32)
+        n_kept_local = jnp.full(ndev, n_local, jnp.int32)
+        neg_prob = jnp.ones(V, jnp.float32)
+        neg_alias = jnp.asarray(np.arange(V, dtype=np.int32))
+        before = np.asarray(emb_out).copy()
+        emb_in, emb_out, loss, pairs, next_keys = fn(
+            emb_in, emb_out, kept, ksent, neg_prob, neg_alias, keys,
+            bases, lrs, n_kept_local)
+        assert np.isfinite(float(loss)) and float(pairs) > 0
+        assert not np.allclose(np.asarray(emb_out), before)  # trained
+        # Averaged result is a single replicated array; keys advanced.
+        assert emb_in.shape == (V, D)
+        assert next_keys.shape == keys.shape
+        assert not np.array_equal(np.asarray(next_keys),
+                                  np.asarray(keys))
+
+
 class TestPSDevicePipeline:
     def test_ps_device_pipeline_trains_through_tables(self, tmp_path):
         # The HBM corpus pipeline driving PARAMETER-SERVER tables with
