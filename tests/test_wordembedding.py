@@ -325,6 +325,13 @@ class TestMAWord2Vec:
         assert next_keys.shape == keys.shape
         assert not np.array_equal(np.asarray(next_keys),
                                   np.asarray(keys))
+        # Chained dispatch with the advanced keys draws FRESH windows:
+        # a second group over the same bases must not reproduce the
+        # first group's loss (replayed keys would, bit for bit).
+        _, _, loss2, _, _ = fn(
+            emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
+            next_keys, bases, lrs, n_kept_local)
+        assert float(loss2) != float(loss)
 
 
 class TestPSDevicePipeline:
