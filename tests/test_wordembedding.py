@@ -390,6 +390,37 @@ class TestPSDevicePipeline:
         finally:
             mv.shutdown()
 
+    def test_ps_device_pipeline_bsp_sync(self, tmp_path):
+        # The device-key PS pipeline under -sync=true: both workers
+        # issue identical per-block op sequences (same corpus, same
+        # seeds), so the SyncServer vector clock must admit every pull
+        # and training must converge.
+        from multiverso_tpu.models.wordembedding import (
+            PSDeviceCorpusTrainer, PSWord2Vec, TokenizedCorpus)
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=300)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+
+        def body(rank):
+            config = Word2VecConfig(embedding_size=8, window=3,
+                                    epochs=2, init_learning_rate=0.02,
+                                    batch_size=256, sample=0)
+            model = PSWord2Vec(config, d)
+            trainer = PSDeviceCorpusTrainer(model, tok,
+                                            centers_per_step=128)
+            losses = []
+            for epoch in range(2):
+                loss, examples = trainer.train_epoch(seed=epoch)
+                losses.append(loss / max(examples, 1))
+            return losses
+
+        results = LocalCluster(2, argv=["-sync=true"],
+                               roles=["all", "worker"]).run(body)
+        for losses in results:
+            assert losses[-1] < losses[0], losses
+
     def test_ps_device_pipeline_two_workers(self, tmp_path):
         # Two virtual worker ranks drive the device-key PS pipeline
         # against one shared server (device keys need a single server):
