@@ -57,24 +57,16 @@ def _prep(flat, sent, keep, key):
     return kept, ksent, mask.sum(dtype=jnp.int32)
 
 
-def _window_and_negs(C, W, K, n, kept, ksent, neg_prob, neg_alias, key,
-                     base, n_kept):
-    """The in-jit batch former shared by the local and PS pipelines:
+def _window(C, W, n, kept, ksent, k_shrink, base, n_kept):
+    """The in-jit window former shared by every device pipeline:
     C consecutive kept positions as centers, the per-center shrunk
     window masked against sentence bounds (the word2vec trick,
-    ref: wordembedding.cpp Train window sampling), and K negatives PER
-    CENTER via the alias tables — shared by that center's (at most 2W)
-    context pairs with the negative loss weighted by the center's
-    valid-pair count. Expected gradient equals the reference's per-pair
-    draws (each pair still sees K ^0.75-unigram negatives); sharing
-    cuts the negative draw/gather/scatter volume 2W-fold, which is what
-    the random 4-byte alias lookups and 512-byte row gathers are bound
-    by on TPU. Returns (centers[C], ctx[C,2W], negs[C,K], pmask[C,2W])."""
+    ref: wordembedding.cpp Train window sampling). Returns
+    (centers[C], ctx[C,2W], pmask[C,2W])."""
     offs = np.concatenate([np.arange(-W, 0),
                            np.arange(1, W + 1)]).astype(np.int32)
     offs_dev = jnp.asarray(offs)
     abs_offs = jnp.asarray(np.abs(offs))
-    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
     idx = base + jnp.arange(C, dtype=jnp.int32)
     safe = jnp.minimum(idx, n - 1)
     centers = kept[safe]
@@ -88,10 +80,26 @@ def _window_and_negs(C, W, K, n, kept, ksent, neg_prob, neg_alias, key,
     valid = (inb & (ksent[cposc] == csent[:, None])
              & (abs_offs[None, :] <= shrink[:, None])
              & center_ok[:, None])
+    return centers, ctx, valid.astype(jnp.float32)
+
+
+def _window_and_negs(C, W, K, n, kept, ksent, neg_prob, neg_alias, key,
+                     base, n_kept):
+    """``_window`` plus K negatives PER CENTER via the alias tables —
+    shared by that center's (at most 2W) context pairs with the
+    negative loss weighted by the center's valid-pair count. Expected
+    gradient equals the reference's per-pair draws (each pair still
+    sees K ^0.75-unigram negatives); sharing cuts the negative
+    draw/gather/scatter volume 2W-fold, which is what the random 4-byte
+    alias lookups and 512-byte row gathers are bound by on TPU.
+    Returns (centers[C], ctx[C,2W], negs[C,K], pmask[C,2W])."""
+    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+    centers, ctx, pmask = _window(C, W, n, kept, ksent, k_shrink, base,
+                                  n_kept)
     draw = jax.random.randint(k_idx, (C, K), 0, neg_prob.shape[0])
     keep_draw = jax.random.uniform(k_keep, (C, K)) < neg_prob[draw]
     negs = jnp.where(keep_draw, draw, neg_alias[draw])
-    return centers, ctx, negs, valid.astype(jnp.float32)
+    return centers, ctx, negs, pmask
 
 
 def _sgns_loss_and_grads(v, u_ctx, u_neg, pmask):
@@ -172,6 +180,68 @@ def _apply_step(C, W, K, n, cbow, emb_in, emb_out, kept, ksent,
     return emb_in, emb_out, loss, pmask.sum()
 
 
+def _make_group(step):
+    """The scan driver shared by every device group program: carry the
+    tables + PRNG key through G steps, sum losses/examples, return the
+    advanced key, donate the table buffers."""
+
+    def group(emb_in, emb_out, kept, ksent, aux1, aux2,
+              key, bases, lrs, n_kept):
+        def body(carry, xs):
+            emb_in, emb_out, key = carry
+            base, lr = xs
+            key, sub = jax.random.split(key)
+            emb_in, emb_out, loss, pairs = step(
+                emb_in, emb_out, kept, ksent, aux1, aux2, sub, base,
+                lr, n_kept)
+            return (emb_in, emb_out, key), (loss, pairs)
+
+        (emb_in, emb_out, key), (losses, pairs) = jax.lax.scan(
+            body, (emb_in, emb_out, key), (bases, lrs))
+        return emb_in, emb_out, losses.sum(), pairs.sum(), key
+
+    return jax.jit(group, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _group_fn_hs(C: int, W: int, n: int):
+    """Hierarchical-softmax group: skip-gram over the context word's
+    Huffman path — input = center row, outputs = the inner-node rows on
+    ``points[ctx]``, labels ``1 - code`` (code 0 = positive, the
+    word2vec convention; ref: wordembedding.cpp HS branch). The aux
+    argument slots carry (points, codes) [V, L] (-1 padded) instead of
+    the SGNS alias tables — same arity as ``_group_fn``, so the trainer
+    drives either interchangeably."""
+
+    def step(emb_in, emb_out, kept, ksent, points, codes,
+             key, base, lr, n_kept):
+        k_shrink, _ = jax.random.split(key)
+        centers, ctx, pmask = _window(C, W, n, kept, ksent, k_shrink,
+                                      base, n_kept)
+        ctx_safe = jnp.clip(ctx, 0, points.shape[0] - 1)
+        path = points[ctx_safe]          # [C, 2W, L]
+        code = codes[ctx_safe]           # [C, 2W, L], -1 padded
+        out_ids = jnp.maximum(path, 0)
+        mask = ((path >= 0) & (code >= 0)).astype(jnp.float32) \
+            * pmask[..., None]
+        labels = (1.0 - code.astype(jnp.float32)) * mask
+        v = emb_in[centers]              # [C, D]
+        u = emb_out[out_ids]             # [C, 2W, L, D]
+
+        def loss_fn(v, u):
+            logits = jnp.clip(jnp.einsum("cd,cwld->cwl", v, u),
+                              -_MAX_EXP, _MAX_EXP)
+            return jnp.sum(_sigmoid_xent(logits, labels) * mask)
+
+        loss, (g_v, g_u) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(v, u)
+        emb_in = emb_in.at[centers].add(-lr * g_v)
+        emb_out = emb_out.at[out_ids].add(-lr * g_u)
+        return emb_in, emb_out, loss, pmask.sum()
+
+    return _make_group(step)
+
+
 # Module-level cache so every trainer instance with the same static
 # shape (C, window, negative, corpus length, mode) shares one compiled
 # group program — a warmup trainer's compile pays for the timed one.
@@ -183,22 +253,7 @@ def _group_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
                            ksent, neg_prob, neg_alias, key, base, lr,
                            n_kept)
 
-    def group(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
-              key, bases, lrs, n_kept):
-        def body(carry, xs):
-            emb_in, emb_out, key = carry
-            base, lr = xs
-            key, sub = jax.random.split(key)
-            emb_in, emb_out, loss, pairs = step(
-                emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
-                sub, base, lr, n_kept)
-            return (emb_in, emb_out, key), (loss, pairs)
-
-        (emb_in, emb_out, key), (losses, pairs) = jax.lax.scan(
-            body, (emb_in, emb_out, key), (bases, lrs))
-        return emb_in, emb_out, losses.sum(), pairs.sum(), key
-
-    return jax.jit(group, donate_argnums=(0, 1))
+    return _make_group(step)
 
 
 @functools.lru_cache(maxsize=None)
@@ -269,10 +324,6 @@ class _CorpusOnDevice:
 
     def __init__(self, model, tokenized: TokenizedCorpus):
         config = model.config
-        if config.hs:
-            raise ValueError("device corpus training covers negative "
-                             "sampling (skip-gram + CBOW); hierarchical "
-                             "softmax stays on the batch path")
         flat = np.asarray(tokenized.flat, np.int32)
         lengths = np.diff(tokenized.offsets).astype(np.int64)
         sent = np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
@@ -288,22 +339,46 @@ class _CorpusOnDevice:
 
 class DeviceCorpusTrainer:
     """Drives a ``Word2Vec`` model's embeddings straight from a
-    device-resident ``TokenizedCorpus``. Negative sampling in both
-    skip-gram (the reference's default and the bench headline) and CBOW
-    modes; hierarchical softmax stays on the general host-batch path."""
+    device-resident ``TokenizedCorpus``. Covers skip-gram negative
+    sampling (the reference's default and the bench headline), CBOW
+    negative sampling, and skip-gram hierarchical softmax; the CBOW+HS
+    combination stays on the general host-batch path."""
 
     def __init__(self, model, tokenized: TokenizedCorpus,
                  centers_per_step: int = 32768,
                  steps_per_dispatch: int = 8):
         config = model.config
+        if config.hs and config.cbow:
+            raise ValueError("device corpus training covers skip-gram "
+                             "HS; CBOW+HS stays on the batch path")
         self.model = model
         self.config = config
         self._C = int(centers_per_step)
         self._G = int(steps_per_dispatch)
         self._corpus = _CorpusOnDevice(model, tokenized)
         self._n_tokens = self._corpus.n_tokens
-        self._group = _group_fn(self._C, config.window, config.negative,
-                                self._n_tokens, bool(config.cbow))
+        if config.hs:
+            # HS activations are [C, 2W, L, D] (L = max Huffman path,
+            # ~log2 vocab) — orders of magnitude bigger per center than
+            # SGNS. Cap C so u + its grad stay within ~1 GB; callers
+            # can pass a smaller centers_per_step, larger is refused by
+            # the cap rather than by an HBM OOM mid-epoch.
+
+            path_len = max(int(model._points_host.shape[1]), 1)
+            dim = int(config.embedding_size)
+            budget = 1 << 30  # bytes for the gathered path rows
+            cap = max(budget // (2 * config.window * path_len * dim * 4),
+                      64)
+            self._C = min(self._C, cap)
+            self._group = _group_fn_hs(self._C, config.window,
+                                       self._n_tokens)
+            # aux slots: the Huffman path/code tables.
+            self._aux = (model._points_dev, model._codes_dev)
+        else:
+            self._group = _group_fn(self._C, config.window,
+                                    config.negative, self._n_tokens,
+                                    bool(config.cbow))
+            self._aux = (model._neg_prob_dev, model._neg_alias_dev)
         # Post-subsampling tokens actually trained (centers), across
         # epochs — the exact basis for utilization accounting.
         self.kept_words_trained = 0
@@ -343,7 +418,7 @@ class DeviceCorpusTrainer:
             (model._emb_in, model._emb_out, loss, pairs,
              key) = self._group(
                 model._emb_in, model._emb_out, kept, ksent,
-                model._neg_prob_dev, model._neg_alias_dev, key,
+                self._aux[0], self._aux[1], key,
                 jnp.asarray(bases), jnp.asarray(lrs), n_kept_dev)
             loss_acc = loss if loss_acc is None else loss_acc + loss
             pair_acc = pairs if pair_acc is None else pair_acc + pairs
@@ -413,6 +488,10 @@ class PSDeviceCorpusTrainer:
     def __init__(self, model, tokenized: TokenizedCorpus,
                  centers_per_step: int = 32768):
         config = model.config
+        if config.hs:
+            raise ValueError("the PS device pipeline covers negative "
+                             "sampling; hierarchical softmax uses the "
+                             "host-batch PS path")
         if not getattr(model, "_device_path", False):
             raise ValueError("PS device pipeline needs in-process "
                              "servers (device path)")

@@ -272,7 +272,30 @@ class TestDeviceCorpusTrainer:
         sep = topic_separation(model, d)
         assert sep > 0.3, f"separation {sep}"
 
-    def test_device_pipeline_rejects_hs(self, tmp_path):
+    def test_device_pipeline_hs_separates_topics(self, tmp_path):
+        # Hierarchical softmax on the device pipeline: skip-gram over
+        # the context word's Huffman path (code 0 = positive).
+        from multiverso_tpu.models.wordembedding import (
+            DeviceCorpusTrainer, TokenizedCorpus)
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+        config = Word2VecConfig(embedding_size=16, window=3, epochs=3,
+                                init_learning_rate=0.02, batch_size=1024,
+                                sample=0, hs=True, negative=0)
+        model = Word2Vec(config, d)
+        trainer = DeviceCorpusTrainer(model, tok, centers_per_step=128,
+                                      steps_per_dispatch=4)
+        losses = []
+        for epoch in range(3):
+            loss, pairs = trainer.train_epoch(seed=epoch)
+            losses.append(loss / max(pairs, 1))
+        assert losses[-1] < losses[0], losses
+        sep = topic_separation(model, d)
+        assert sep > 0.3, f"separation {sep}"
+
+    def test_device_pipeline_rejects_cbow_hs_combo(self, tmp_path):
         from multiverso_tpu.models.wordembedding import (
             DeviceCorpusTrainer, TokenizedCorpus)
         path = tmp_path / "corpus.txt"
@@ -280,7 +303,7 @@ class TestDeviceCorpusTrainer:
         d = Dictionary.build(str(path), min_count=1)
         tok = TokenizedCorpus.build(d, str(path))
         model = Word2Vec(Word2VecConfig(embedding_size=8, hs=True,
-                                        negative=0), d)
+                                        cbow=True, negative=0), d)
         with pytest.raises(ValueError):
             DeviceCorpusTrainer(model, tok)
 
