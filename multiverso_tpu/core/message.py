@@ -109,6 +109,30 @@ class Message:
                 f"table={self.table_id}, msg_id={self.msg_id}, blobs={len(self.data)})")
 
 
+# Header slot 5 carries an error flag on replies (0 = ok). The reference
+# leaves slots 5-7 unused (message.h:28-38); using one lets a server-side
+# failure travel back to the requester instead of degrading to an empty
+# reply, so the caller's wait() can raise rather than return garbage.
+ERROR_SLOT = 5
+
+
+def mark_error(reply: "Message", exc: BaseException) -> None:
+    """Flag a reply as failed and replace its payload with the error text
+    (utf-8 bytes in a single blob)."""
+    reply.header[ERROR_SLOT] = 1
+    text = f"{type(exc).__name__}: {exc}".encode(errors="replace")
+    reply.data = [Blob(np.frombuffer(text, np.uint8).copy())]
+
+
+def take_error(msg: "Message") -> Optional[str]:
+    """The error text of a failed reply, or None for a normal one."""
+    if msg.header[ERROR_SLOT] == 0:
+        return None
+    if msg.data:
+        return bytes(msg.data[0].as_array(np.uint8)).decode(errors="replace")
+    return "remote table operation failed"
+
+
 def is_server_bound(msg_type: int) -> bool:
     """Request types route to the server actor (ref: communicator.cpp:93-105)."""
     return 0 < msg_type < 32

@@ -53,18 +53,72 @@ def _auth_headers(uri: str) -> Dict[str, str]:
     headers = dict(_auth) if _auth else {}
     token = os.environ.get("MV_HTTP_AUTH_TOKEN")
     if token and "Authorization" not in headers:
-        # Scope the ambient token: only the host named by
-        # MV_HTTP_AUTH_HOST, or any https endpoint when unset — never
-        # cleartext http, where a bearer token would leak to whatever
-        # host (or redirect target) the uri points at. Cross-host or
-        # http use cases must opt in explicitly via set_auth.
+        # The ambient env token is STRICTLY host-scoped: it attaches only
+        # to requests for the host explicitly named by MV_HTTP_AUTH_HOST.
+        # With no host set the token is ignored — an any-https default
+        # would hand a bearer token to whatever endpoint a uri (or a
+        # redirect target) happens to name. Cleartext http is refused
+        # too (an on-path observer would read the token) except to
+        # loopback, where there is no path to observe — the standard
+        # dev-server carve-out. Multi-host or plain-http use cases must
+        # opt in explicitly via set_auth.
         from urllib.parse import urlsplit
         parts = urlsplit(uri)
         wanted = os.environ.get("MV_HTTP_AUTH_HOST")
-        if (parts.hostname == wanted if wanted
-                else parts.scheme == "https"):
+        secure = parts.scheme == "https" or parts.hostname in (
+            "localhost", "127.0.0.1", "::1")
+        if wanted and parts.hostname == wanted and secure:
             headers["Authorization"] = f"Bearer {token}"
     return headers
+
+
+class _AuthScopedRedirectHandler(urllib.request.HTTPRedirectHandler):
+    """urllib's default handler forwards ALL headers across redirects —
+    including Authorization, so even a host-scoped token would leak to an
+    arbitrary cross-host redirect target. Strip it whenever the redirect
+    leaves the original host."""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        new = super().redirect_request(req, fp, code, msg, headers, newurl)
+        if new is not None:
+            from urllib.parse import urlsplit
+
+            def origin(url):
+                # Full origin, not just hostname: a same-host https->http
+                # downgrade would re-send the token in cleartext, and
+                # another port on the same host is another service.
+                p = urlsplit(url)
+                port = p.port if p.port is not None \
+                    else {"https": 443, "http": 80}.get(p.scheme)
+                return (p.scheme, p.hostname, port)
+
+            if origin(newurl) != origin(req.full_url):
+                # Strip EVERY credential the auth hook installed for the
+                # original url (a static set_auth dict may carry
+                # X-Api-Key/Cookie-style headers, not just the Bearer
+                # form), plus Authorization itself.
+                for name in {"Authorization",
+                             *(k.capitalize()
+                               for k in _auth_headers(req.full_url))}:
+                    new.headers.pop(name, None)
+                # Re-consult the auth hook FOR THE TARGET — but only the
+                # per-uri CALLABLE form: it inspects the url and mints
+                # headers per host (presigned/CDN redirect patterns), so
+                # it stays authoritative for where the redirect lands. A
+                # static dict would return the original credentials
+                # unconditionally and recreate the leak just stripped.
+                if callable(_auth):
+                    for name, value in _auth_headers(newurl).items():
+                        if name.capitalize() not in new.headers:
+                            new.add_header(name, value)
+        return new
+
+
+_opener = urllib.request.build_opener(_AuthScopedRedirectHandler)
+
+
+def _urlopen(req: urllib.request.Request):
+    return _opener.open(req)
 
 
 def _request(uri: str, **kw) -> urllib.request.Request:
@@ -76,7 +130,7 @@ def _request(uri: str, **kw) -> urllib.request.Request:
 
 class _HttpReadStream(Stream):
     def __init__(self, uri: str):
-        self._resp = urllib.request.urlopen(  # noqa: S310 - scheme-gated
+        self._resp = _urlopen(  # noqa: S310 - scheme-gated
             _request(uri))
         super().__init__(self._resp, uri)
         self._closed = False
@@ -120,7 +174,7 @@ class _HttpWriteStream(Stream):
         payload = self._buf.getvalue()
         req = _request(self._uri, data=payload, method="PUT")
         req.add_header("Content-Type", "application/octet-stream")
-        with urllib.request.urlopen(req):  # noqa: S310 - scheme-gated
+        with _urlopen(req):  # noqa: S310 - scheme-gated
             pass
 
 

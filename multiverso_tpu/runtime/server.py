@@ -15,18 +15,20 @@ from __future__ import annotations
 import collections
 from typing import Deque, List
 
-from ..core.message import Message, MsgType
+from ..core.message import Message, MsgType, mark_error
 from ..util import log
-from ..util.configure import define_int, get_flag
+from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from .actor import Actor
 
-define_int("backup_worker_ratio", 0,
-           "reserved: integer PERCENTAGE of workers treated as backups "
-           "by the sync server ('set 20 means 20%' — defined-but-unused "
-           "in the reference too, ref: src/server.cpp:21; int to mirror "
-           "the reference flag surface exactly)")
+define_double("backup_worker_ratio", 0,
+              "reserved: PERCENTAGE of workers treated as backups by the "
+              "sync server ('set 20 means 20%' — defined-but-unused in "
+              "the reference too, ref: src/server.cpp:21). Parsed as a "
+              "double so pre-existing fractional configs (-backup_worker_"
+              "ratio=0.2) keep parsing; readers should round to an int "
+              "percentage")
 
 _INF = float("inf")
 
@@ -56,9 +58,16 @@ class Server(Actor):
         with monitor("SERVER_PROCESS_GET"):
             reply = msg.create_reply_message()
             # The reply goes out even if table logic raises — a swallowed
-            # reply would deadlock the requester's waiter forever.
+            # reply would deadlock the requester's waiter forever — and a
+            # failure travels back as an error reply so the requester's
+            # wait() RAISES instead of consuming an empty payload (the
+            # actor loop only logs; without this, every server-side CHECK
+            # degrades to silent garbage at the caller).
             try:
                 reply.data = self._store[msg.table_id].process_get(msg.data)
+            except Exception as exc:  # noqa: BLE001
+                mark_error(reply, exc)
+                raise
             finally:
                 self.send_to(actors.COMMUNICATOR, reply)
 
@@ -68,6 +77,9 @@ class Server(Actor):
             reply = msg.create_reply_message()
             try:
                 self._store[msg.table_id].process_add(msg.data)
+            except Exception as exc:  # noqa: BLE001
+                mark_error(reply, exc)
+                raise
             finally:
                 self.send_to(actors.COMMUNICATOR, reply)
 
@@ -135,10 +147,17 @@ class SyncServer(Server):
             self._add_cache.append(msg)
             self._num_waited_add[worker] += 1
             return
-        super()._process_add(msg)
-        if self._add_clocks.update(worker):
-            assert not self._add_cache
-            self._drain_get_cache()
+        # The clock MUST tick even when table logic raises (the error
+        # reply went out and the worker sees a recoverable failure) —
+        # skipping it would leave this worker's clock permanently behind
+        # and the BSP gate would cache every other worker's requests
+        # forever: a cluster-wide hang from one bad request.
+        try:
+            super()._process_add(msg)
+        finally:
+            if self._add_clocks.update(worker):
+                assert not self._add_cache
+                self._drain_get_cache()
 
     # ref: src/server.cpp:165-188
     def _process_get(self, msg: Message) -> None:
@@ -148,9 +167,11 @@ class SyncServer(Server):
                 or self._num_waited_add[worker] > 0):
             self._get_cache.append(msg)
             return
-        super()._process_get(msg)
-        if self._get_clocks.update(worker):
-            self._drain_add_cache()
+        try:
+            super()._process_get(msg)
+        finally:
+            if self._get_clocks.update(worker):
+                self._drain_add_cache()
 
     # ref: src/server.cpp:190-213
     def _process_finish_train(self, msg: Message) -> None:
@@ -166,14 +187,27 @@ class SyncServer(Server):
         while self._get_cache:
             get_msg = self._get_cache.popleft()
             worker = self._zoo.rank_to_worker_id(get_msg.src)
-            Server._process_get(self, get_msg)
+            # A raising drained request already sent its error reply;
+            # swallow here (with the log line Server._process_* emitted
+            # via its raise path unavailable, log directly) so the rest
+            # of the cache still drains and the clocks stay level.
+            try:
+                Server._process_get(self, get_msg)
+            except Exception:  # noqa: BLE001
+                log.error("sync server: drained get failed "
+                          "(error reply sent)")
             leveled = self._get_clocks.update(worker)
             assert not leveled
+
     def _drain_add_cache(self) -> None:
         while self._add_cache:
             add_msg = self._add_cache.popleft()
             worker = self._zoo.rank_to_worker_id(add_msg.src)
-            Server._process_add(self, add_msg)
+            try:
+                Server._process_add(self, add_msg)
+            except Exception:  # noqa: BLE001
+                log.error("sync server: drained add failed "
+                          "(error reply sent)")
             leveled = self._add_clocks.update(worker)
             assert not leveled
             self._num_waited_add[worker] -= 1

@@ -198,11 +198,16 @@ class TcpNet(NetInterface):
             # sendall indefinitely.
             locked = self._out_locks[dst].acquire(timeout=2.0)
             try:
-                try:
-                    sock.settimeout(2.0)
-                    sock.sendall(_LEN.pack(0))
-                except OSError:
-                    pass
+                if locked:
+                    # Without the lock, a goodbye could interleave into a
+                    # frame a sender is mid-writing and corrupt the
+                    # peer's stream; skipping it merely degrades to the
+                    # dirty-close signal the goodbye would have avoided.
+                    try:
+                        sock.settimeout(2.0)
+                        sock.sendall(_LEN.pack(0))
+                    except OSError:
+                        pass
                 try:
                     sock.close()
                 except OSError:

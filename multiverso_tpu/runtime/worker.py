@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from typing import List
 
-from ..core.message import Message, MsgType
+from ..core.message import Message, MsgType, take_error
+from ..util.configure import get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from .actor import Actor
@@ -49,10 +50,30 @@ class Worker(Actor):
         table = self._cache[msg.table_id]
         try:
             partitions = table.partition(msg.data, msg_type)
-        except Exception:
-            # Release the caller's waiter before surfacing the error — a
-            # hung Wait() would mask the real failure.
-            table.reset(msg.msg_id, 0)
+        except Exception as exc:
+            # Record the failure on the request and release the caller's
+            # waiter — wait() raises instead of returning 'success' over
+            # an untouched destination buffer (the actor loop only logs).
+            if get_flag("sync", False):
+                # BSP: the sync servers must still observe one request
+                # from this worker or its vector clock falls permanently
+                # behind and the gate caches every OTHER worker's
+                # requests forever. Send an empty shard to every server:
+                # its table logic fails (error reply — first recorded
+                # error wins at the caller) but the sync server's
+                # finally-tick keeps the clocks level.
+                table.fail(msg.msg_id, f"partition failed: {exc}",
+                           count=False)
+                table.reset(msg.msg_id, self._zoo.num_servers)
+                for server_id in range(self._zoo.num_servers):
+                    shard = Message(src=self._zoo.rank,
+                                    dst=self._zoo.server_rank(server_id),
+                                    msg_type=msg_type,
+                                    table_id=msg.table_id,
+                                    msg_id=msg.msg_id)
+                    self.send_to(actors.COMMUNICATOR, shard)
+            else:
+                table.fail(msg.msg_id, f"partition failed: {exc}")
             raise
         table.reset(msg.msg_id, len(partitions))
         for server_id, blobs in partitions.items():
@@ -66,13 +87,28 @@ class Worker(Actor):
     # ref: src/worker.cpp:78-84
     def _process_reply_get(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
-        # notify() must run even if reply handling raises — a swallowed
-        # notify deadlocks the requester's wait().
+        # Every shard reply — error or not — counts exactly one notify
+        # (the finally), so the waiter completes only after ALL shards
+        # report; wait() then raises on any recorded failure. Releasing
+        # early on the first error would let a late sibling reply write
+        # into a subsequent request's destination registers.
         try:
-            table.process_reply_get(msg.data)
+            error = take_error(msg)
+            if error is not None:
+                table.fail(msg.msg_id, error, count=False)
+            else:
+                table.process_reply_get(msg.data)
+        except Exception as exc:
+            table.fail(msg.msg_id, f"reply handling failed: {exc}",
+                       count=False)
+            raise
         finally:
             table.notify(msg.msg_id)
 
     # ref: src/worker.cpp:86-88
     def _process_reply_add(self, msg: Message) -> None:
-        self._cache[msg.table_id].notify(msg.msg_id)
+        table = self._cache[msg.table_id]
+        error = take_error(msg)
+        if error is not None:
+            table.fail(msg.msg_id, error, count=False)
+        table.notify(msg.msg_id)

@@ -148,12 +148,24 @@ class MatrixTableOption:
 
 class MatrixWorker(WorkerTable):
     def __init__(self, num_row: int, num_col: int, dtype=np.float32,
-                 is_sparse: bool = False, zoo=None):
+                 is_sparse: bool = False, zoo=None,
+                 updater_type: Optional[str] = None):
         super().__init__(zoo=zoo)
         self.num_row = int(num_row)
         self.num_col = int(num_col)
         self.dtype = np.dtype(dtype)
         self.is_sparse = bool(is_sparse)
+        # Device-key row adds may carry duplicate ids, which only sum
+        # correctly under stateless rules. The server-side engine CHECK
+        # fires inside the server actor, where _safe_dispatch swallows it
+        # and the Add ack never comes — so a misconfigured trainer hangs
+        # in wait() instead of raising. Validate here, in the CALLER's
+        # thread (the factory passes the table's updater_type along),
+        # deriving statelessness from the rule registry so this cannot
+        # drift from the engine's actual state handling (e.g. int tables
+        # and unknown names both resolve to the stateless default adder).
+        self._updater_stateless = create_rule(updater_type,
+                                              self.dtype).stateless
         # Wire compression for sparse traffic, both directions, as the
         # reference does unconditionally (sparse_matrix_table.cpp:148-153);
         # here behind a flag read at table-construction time — and only
@@ -182,6 +194,17 @@ class MatrixWorker(WorkerTable):
         self._device_shards: Optional[Dict[int, object]] = None
         self._device_shard_ids: Optional[Dict[int, np.ndarray]] = None
 
+    def _check_row_ids(self, row_ids: np.ndarray) -> None:
+        """Fail fast in the CALLER on out-of-range ids. partition() runs
+        inside the worker actor, where an exception is swallowed after
+        reset(msg_id, 0) — the caller would see a 'successful' request
+        backed by uninitialized memory (stray negative) or block forever
+        on a shard routed to server -1 (negative id in a vector)."""
+        if row_ids.size:
+            lo, hi = int(row_ids.min()), int(row_ids.max())
+            CHECK(lo >= 0 and hi < self.num_row,
+                  "row ids out of range [0, num_row)")
+
     # -- Get API (ref: matrix_table.cpp:58-105) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
         self.wait(self.get_async(out))
@@ -207,6 +230,7 @@ class MatrixWorker(WorkerTable):
     def get_rows_async(self, row_ids,
                        out: Optional[np.ndarray] = None) -> int:
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
+        self._check_row_ids(row_ids)
         if out is None:
             out = np.empty((row_ids.size, self.num_col), self.dtype)
         CHECK(out.shape == (row_ids.size, self.num_col), "bad output shape")
@@ -255,6 +279,7 @@ class MatrixWorker(WorkerTable):
             return self._request_get(Blob(row_ids))
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
         CHECK(row_ids.size > 0, "empty device row get")
+        self._check_row_ids(row_ids)
         CHECK(not self._compress, "device gets bypass wire compression")
         if self._num_server > 1:
             CHECK(bool(np.all(np.diff(row_ids) >= 0)),
@@ -317,6 +342,9 @@ class MatrixWorker(WorkerTable):
                   "device-key row adds need a single server")
             CHECK(self._zoo.net.in_process,
                   "device-key row adds need in-process servers")
+            CHECK(self._updater_stateless,
+                  "device-key row adds need a stateless updater "
+                  "(default/sgd): duplicate ids must sum")
             CHECK(is_device_array(delta),
                   "device-key adds need a device delta")
             CHECK(tuple(delta.shape) ==
@@ -325,6 +353,13 @@ class MatrixWorker(WorkerTable):
             return self.add_async_raw(Blob(row_ids), Blob(delta),
                                       self._option_blob(option))
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
+        self._check_row_ids(row_ids)
+        if self._one_bit:
+            # _onebit_chunk's error-feedback gather/write-back breaks on
+            # duplicates; its own CHECK fires inside the worker actor —
+            # raise here in the caller instead.
+            CHECK(np.unique(row_ids).size == row_ids.size,
+                  "one-bit row pushes need unique row ids")
         if not is_device_array(delta):
             delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
         CHECK(int(np.prod(delta.shape)) == row_ids.size * self.num_col,
@@ -374,7 +409,12 @@ class MatrixWorker(WorkerTable):
             return {0: list(blobs)}
         keys = blobs[0].as_array(np.int32)
         out: Dict[int, List[Blob]] = {}
-        if keys.size == 1 and keys[0] < 0:  # -1 / -2 whole-table sentinels
+        if keys.size == 1 and keys[0] < 0:
+            # Only the two defined sentinels may go negative; a stray
+            # negative row id must fail fast here, not fan out as a
+            # whole-table request with undefined server-side handling.
+            CHECK(keys[0] in (-1, -2),
+                  "negative key must be a whole-table sentinel (-1/-2)")
             is_add = msg_type == MsgType.Request_Add
             compress = is_add and self._compress
             values = blobs[1].typed(self.dtype) if is_add else None

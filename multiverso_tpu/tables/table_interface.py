@@ -30,6 +30,13 @@ from ..util.dashboard import monitor
 from ..util.waiter import Waiter
 
 
+class TableRequestError(RuntimeError):
+    """A table request failed remotely (server-side table logic or
+    worker-side partition); raised by ``wait`` in the REQUESTER's thread.
+    The actor runtime can only log — this carries the failure to the code
+    that can actually handle it."""
+
+
 class WorkerTable:
     """Client-side handle; lives on every worker rank."""
 
@@ -38,6 +45,7 @@ class WorkerTable:
         self.table_id: int = self._zoo.register_worker_table(self)
         self._msg_id = 0
         self._waitings: Dict[int, Waiter] = {}
+        self._errors: Dict[int, str] = {}
         self._mutex = threading.Lock()
 
     # -- public sync API (ref: src/table.cpp:29-38) --
@@ -93,13 +101,21 @@ class WorkerTable:
         with self._mutex:
             waiter = self._waitings.get(msg_id)
         if waiter is None:
+            self._raise_if_failed(msg_id)
             return True  # already completed
         ok = waiter.wait(timeout=timeout)
         self._check_aborted()
         if ok:
             with self._mutex:
                 self._waitings.pop(msg_id, None)
+            self._raise_if_failed(msg_id)
         return ok
+
+    def _raise_if_failed(self, msg_id: int) -> None:
+        with self._mutex:
+            error = self._errors.pop(msg_id, None)
+        if error is not None:
+            raise TableRequestError(error)
 
     def _check_aborted(self) -> None:
         reason = getattr(self, "_abort_reason", None)
@@ -117,6 +133,26 @@ class WorkerTable:
             waiters = list(self._waitings.values())
         for waiter in waiters:
             waiter.release()
+
+    def fail(self, msg_id: int, reason: str, count: bool = True) -> None:
+        """Record a remote failure for a request; the requester's
+        ``wait(msg_id)`` raises TableRequestError once the request
+        completes. With ``count`` the failure also counts as one shard
+        reply (notify) — it must NOT release the waiter outright: a
+        multi-shard request with sibling replies still in flight would
+        otherwise unblock early, and a late sibling could write into the
+        NEXT request's destination (the one-get-in-flight registers are
+        shared). Callers whose control flow already notifies (the reply
+        handlers' finally blocks) pass ``count=False``. Entries for
+        requests nobody waits on persist until shutdown — errors are
+        bugs, not steady-state traffic."""
+        with self._mutex:
+            # First error wins: follow-up failures of the same request
+            # (e.g. the empty BSP clock-tick shards sent after a
+            # partition failure) must not mask the root cause.
+            self._errors.setdefault(msg_id, reason)
+        if count:
+            self.notify(msg_id)
 
     def reset(self, msg_id: int, num_wait: int) -> None:
         with self._mutex:

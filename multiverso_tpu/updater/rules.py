@@ -66,6 +66,11 @@ class UpdaterRule:
     """A pure update rule: (data, state, delta, hyp, worker_id) -> (data, state)."""
 
     name = "base"
+    # True when init_state returns None — i.e. duplicate row ids in one
+    # scatter-add SUM correctly. Worker-side device-key validation
+    # consults this through create_rule so it cannot drift from the
+    # engine's state handling.
+    stateless = True
 
     def init_state(self, shape, dtype, num_workers: int) -> Any:
         return None
@@ -102,6 +107,7 @@ class SGDRule(UpdaterRule):
 
 class MomentumRule(UpdaterRule):
     name = "momentum"
+    stateless = False
 
     def init_state(self, shape, dtype, num_workers: int):
         return jnp.zeros(shape, dtype)
@@ -121,6 +127,7 @@ class MomentumRule(UpdaterRule):
 
 class AdaGradRule(UpdaterRule):
     name = "adagrad"
+    stateless = False
 
     def init_state(self, shape, dtype, num_workers: int):
         # Per-worker historic squared gradients, leading worker axis
@@ -162,6 +169,7 @@ class DCASGDRule(UpdaterRule):
     is benign, and every later push uses the true snapshot."""
 
     name = "dcasgd"
+    stateless = False
 
     def init_state(self, shape, dtype, num_workers: int):
         return jnp.zeros((num_workers,) + tuple(shape), dtype)

@@ -205,6 +205,34 @@ class TestHttpStream:
         with StreamFactory.get_stream(f"{base}/tok.bin", "r") as s:
             assert s.read() == b"x"
 
+    def test_redirect_strips_auth_cross_host(self):
+        # urllib forwards Authorization across redirects by default; the
+        # scoped handler must strip it when the redirect leaves the
+        # original host (and keep it same-host).
+        import io
+        import urllib.request
+        from email.message import Message as HdrMessage
+        from multiverso_tpu.io.http_stream import _AuthScopedRedirectHandler
+
+        def redirect(newurl):
+            req = urllib.request.Request("https://a.example/obj")
+            req.add_header("Authorization", "Bearer tok")
+            hdrs = HdrMessage()
+            hdrs["Location"] = newurl
+            fp = io.BytesIO(b"")
+            return _AuthScopedRedirectHandler().redirect_request(
+                req, fp, 302, "Found", hdrs, newurl)
+
+        kept = redirect("https://a.example/elsewhere")
+        assert kept.headers.get("Authorization") == "Bearer tok"
+        stripped = redirect("https://evil.example/steal")
+        assert "Authorization" not in stripped.headers
+        # Same host but scheme downgrade / other port = different origin.
+        downgraded = redirect("http://a.example/obj")
+        assert "Authorization" not in downgraded.headers
+        other_port = redirect("https://a.example:8443/obj")
+        assert "Authorization" not in other_port.headers
+
     def test_text_reader_over_http(self, http_store):
         import multiverso_tpu.io.http_stream  # noqa: F401
         base, store = http_store

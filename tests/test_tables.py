@@ -327,6 +327,102 @@ class TestDeviceResidentPath:
         ids2, _ = table.get_dirty_device()  # now clean
         assert ids2.size == 0
 
+    def test_device_keys_rejected_stateful_updater(self, env):
+        # Duplicate device ids only SUM correctly under stateless rules;
+        # the misconfiguration must raise in the CALLER (the server-side
+        # CHECK fires inside the actor, which swallows it and the ack
+        # never comes — a silent hang).
+        import jax.numpy as jnp
+        table = mv.create_matrix_table(16, 4, updater_type="momentum")
+        with pytest.raises(Exception, match="stateless"):
+            table.add_rows(jnp.asarray(np.array([1, 2], np.int32)),
+                           jnp.ones((2, 4), jnp.float32))
+
+    def test_stray_negative_key_fails_fast(self, env):
+        # Only -1/-2 are whole-table sentinels; any other negative id
+        # must raise in the CALLER (partition runs inside the worker
+        # actor, where an exception degrades to a silent bad reply).
+        table = mv.create_matrix_table(16, 4)
+        with pytest.raises(Exception, match="out of range"):
+            table.get_rows(np.array([-3], np.int32))
+        with pytest.raises(Exception, match="out of range"):
+            table.add_rows(np.array([-3, 5], np.int32),
+                           np.ones((2, 4), np.float32))
+        with pytest.raises(Exception, match="out of range"):
+            table.get_rows(np.array([16], np.int32))
+        # Defense in depth: partition itself also rejects non-sentinels.
+        with pytest.raises(Exception, match="sentinel"):
+            table.partition([Blob(np.array([-3], np.int32).view(np.uint8))],
+                            MsgType.Request_Get)
+
+    def test_sync_server_ticks_clock_on_error(self):
+        # BSP: a failed add must still tick the vector clock — otherwise
+        # the failed worker's clock stays behind and the gate caches
+        # every other worker's requests forever (cluster-wide hang).
+        from multiverso_tpu.tables.table_interface import TableRequestError
+
+        def body(rank):
+            table = mv.create_matrix_table(8, 2)
+            if rank == 0:  # bad add: wrong-sized whole-table delta
+                mid = table.add_async_raw(
+                    Blob(np.array([-1], np.int32).view(np.uint8)),
+                    Blob(np.ones(3, np.float32)))
+                failed = False
+                try:
+                    table.wait(mid)
+                except TableRequestError:
+                    failed = True
+            else:
+                table.add(np.ones((8, 2), np.float32))
+                failed = None
+            got = table.get()  # would hang without the clock tick
+            # Round 2: WORKER-side failure (partition raises before any
+            # shard is sent) — the empty clock-tick shards must keep the
+            # BSP clocks level for the other worker.
+            if rank == 0:
+                mid = table.add_async_raw(
+                    Blob(np.array([-9], np.int32).view(np.uint8)),
+                    Blob(np.ones(2, np.float32)))
+                try:
+                    table.wait(mid)
+                    failed = False
+                except TableRequestError as exc:
+                    failed = failed and "partition" in str(exc)
+            else:
+                table.add(np.ones((8, 2), np.float32))
+            got2 = table.get()  # would hang without the tick shards
+            mv.current_zoo().barrier()
+            return failed, float(got[0, 0]), float(got2[0, 0])
+
+        results = LocalCluster(2, argv=["-sync=true"]).run(body)
+        assert results[0][0] is True
+        assert results[0][1] == results[1][1] == 1.0
+        assert results[0][2] == results[1][2] == 2.0
+
+    def test_remote_failures_raise_in_caller(self, env):
+        # Failures inside the actor runtime must surface as
+        # TableRequestError in the REQUESTER's wait(), not degrade to a
+        # log line plus garbage/empty results (the actor loop swallows).
+        from multiverso_tpu.tables.table_interface import TableRequestError
+        table = mv.create_matrix_table(16, 4)
+        # Worker-side: partition rejects the stray sentinel (raw API
+        # bypasses the caller-side range CHECK).
+        mid = table.get_async_raw(
+            Blob(np.array([-3], np.int32).view(np.uint8)))
+        with pytest.raises(TableRequestError, match="partition"):
+            table.wait(mid)
+        # Server-side: a wrong-sized whole-table add fails in
+        # process_add; the error reply must carry the text back.
+        mid = table.add_async_raw(
+            Blob(np.array([-1], np.int32).view(np.uint8)),
+            Blob(np.ones(7, np.float32)))
+        with pytest.raises(TableRequestError, match="size mismatch"):
+            table.wait(mid)
+        # The table stays usable afterwards.
+        table.add(np.ones((16, 4), np.float32))
+        np.testing.assert_array_equal(table.get(),
+                                      np.ones((16, 4), np.float32))
+
     def test_matrix_device_keys_rejected_multi_server(self):
         def body(rank):
             import jax.numpy as jnp
