@@ -47,28 +47,36 @@ def set_auth(auth: Optional[Union[Dict[str, str],
     _auth = auth
 
 
+def _scoped_env_headers(uri: str) -> Dict[str, str]:
+    """The ambient env token, STRICTLY host-scoped: it attaches only to
+    requests for the host explicitly named by MV_HTTP_AUTH_HOST. With no
+    host set the token is ignored — an any-https default would hand a
+    bearer token to whatever endpoint a uri (or a redirect target)
+    happens to name. Cleartext http is refused too (an on-path observer
+    would read the token) except to loopback, where there is no path to
+    observe — the standard dev-server carve-out. Multi-host or
+    plain-http use cases must opt in explicitly via set_auth. Because
+    this scope check is per-uri, it is safe to re-apply to a redirect
+    target."""
+    token = os.environ.get("MV_HTTP_AUTH_TOKEN")
+    if not token:
+        return {}
+    from urllib.parse import urlsplit
+    parts = urlsplit(uri)
+    wanted = os.environ.get("MV_HTTP_AUTH_HOST")
+    secure = parts.scheme == "https" or parts.hostname in (
+        "localhost", "127.0.0.1", "::1")
+    if wanted and parts.hostname == wanted and secure:
+        return {"Authorization": f"Bearer {token}"}
+    return {}
+
+
 def _auth_headers(uri: str) -> Dict[str, str]:
     if callable(_auth):
         return dict(_auth(uri))
     headers = dict(_auth) if _auth else {}
-    token = os.environ.get("MV_HTTP_AUTH_TOKEN")
-    if token and "Authorization" not in headers:
-        # The ambient env token is STRICTLY host-scoped: it attaches only
-        # to requests for the host explicitly named by MV_HTTP_AUTH_HOST.
-        # With no host set the token is ignored — an any-https default
-        # would hand a bearer token to whatever endpoint a uri (or a
-        # redirect target) happens to name. Cleartext http is refused
-        # too (an on-path observer would read the token) except to
-        # loopback, where there is no path to observe — the standard
-        # dev-server carve-out. Multi-host or plain-http use cases must
-        # opt in explicitly via set_auth.
-        from urllib.parse import urlsplit
-        parts = urlsplit(uri)
-        wanted = os.environ.get("MV_HTTP_AUTH_HOST")
-        secure = parts.scheme == "https" or parts.hostname in (
-            "localhost", "127.0.0.1", "::1")
-        if wanted and parts.hostname == wanted and secure:
-            headers["Authorization"] = f"Bearer {token}"
+    if "Authorization" not in headers:
+        headers.update(_scoped_env_headers(uri))
     return headers
 
 
@@ -101,16 +109,19 @@ class _AuthScopedRedirectHandler(urllib.request.HTTPRedirectHandler):
                              *(k.capitalize()
                                for k in _auth_headers(req.full_url))}:
                     new.headers.pop(name, None)
-                # Re-consult the auth hook FOR THE TARGET — but only the
-                # per-uri CALLABLE form: it inspects the url and mints
-                # headers per host (presigned/CDN redirect patterns), so
-                # it stays authoritative for where the redirect lands. A
-                # static dict would return the original credentials
-                # unconditionally and recreate the leak just stripped.
-                if callable(_auth):
-                    for name, value in _auth_headers(newurl).items():
-                        if name.capitalize() not in new.headers:
-                            new.add_header(name, value)
+                # Re-consult the per-uri auth forms FOR THE TARGET: the
+                # set_auth CALLABLE (it inspects the url and mints
+                # headers per host — presigned/CDN redirect patterns)
+                # and the host-scoped env token (its scope check is
+                # per-uri, so it re-attaches exactly when the redirect
+                # lands on MV_HTTP_AUTH_HOST). A static set_auth dict is
+                # NOT re-applied — it would return the original
+                # credentials unconditionally and recreate the leak.
+                fresh = dict(_auth(newurl)) if callable(_auth) \
+                    else _scoped_env_headers(newurl)
+                for name, value in fresh.items():
+                    if name.capitalize() not in new.headers:
+                        new.add_header(name, value)
         return new
 
 

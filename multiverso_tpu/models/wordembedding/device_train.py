@@ -16,15 +16,30 @@ of the post-subsampling length.
 Per epoch, one jitted ``_prep`` pass draws the subsample mask and
 stably compacts kept tokens to the front (word2vec subsamples BEFORE
 windowing, so windows must span the kept sequence); training then scans
-``steps_per_dispatch`` windowed steps per dispatch: each step takes C
-consecutive kept positions as centers, forms the per-center shrunk
-window against sentence bounds, samples negatives from the unigram^0.75
-alias tables, and applies the batch-summed SGNS update with two
-scatter-adds. TPU cost model that shaped this design (measured on
-v5e): scatter-add costs a table sweep regardless of index count, row
-gathers are O(k) at random-access bandwidth, and tiny random gathers
-(the alias lookups) are the slowest bytes of all — so steps are LARGE
-(C centers ≈ 2WC pairs) and negatives are drawn per center.
+``steps_per_dispatch`` windowed steps per dispatch.
+
+The SGNS/CBOW steps use a BANDED formulation that exploits window
+overlap: the contexts of C consecutive centers all lie in the
+contiguous band ``kept[base-W : base+C+W]``, so the step gathers those
+C+2W rows ONCE and forms the 2W context logits as shifted slices of the
+band — 2W-fold less gather AND scatter row traffic than materializing
+the [C, 2W] context row matrix, which round-3 profiling showed was the
+step's dominant cost (scatter of C*(2W+K) ≈ 0.5M random rows per step).
+The per-center shrunk window and sentence bounds survive as masks on
+the shifted slices; the update math is bit-identical to the row-matrix
+form (duplicates in the band sum, exactly as duplicate scatter ids
+did). Negatives come from the unigram^0.75 alias tables, drawn per
+center by default; ``neg_block`` > 1 shares one draw of K negatives
+across each block of that many consecutive centers (expected gradient
+unchanged — every center still sees K ^0.75-unigram negatives — but the
+random-row traffic for negatives drops by the block factor; measured
+~1.8x words/s at block 32 on v5e).
+
+Measured v5e cost model (see PROGRESS notes, round 4): full-table
+sweeps run near peak (~680 GB/s), row gathers ~50-100 GB/s, random-row
+scatter-adds are the slowest path (~13 GB/s at 32K rows) — so the
+design minimizes SCATTERED ROWS first, gathered rows second, and
+never sweeps.
 """
 
 from __future__ import annotations
@@ -83,110 +98,168 @@ def _window(C, W, n, kept, ksent, k_shrink, base, n_kept):
     return centers, ctx, valid.astype(jnp.float32)
 
 
-def _window_and_negs(C, W, K, n, kept, ksent, neg_prob, neg_alias, key,
-                     base, n_kept):
-    """``_window`` plus K negatives PER CENTER via the alias tables —
-    shared by that center's (at most 2W) context pairs with the
-    negative loss weighted by the center's valid-pair count. Expected
-    gradient equals the reference's per-pair draws (each pair still
-    sees K ^0.75-unigram negatives); sharing cuts the negative
-    draw/gather/scatter volume 2W-fold, which is what the random 4-byte
-    alias lookups and 512-byte row gathers are bound by on TPU.
-    Returns (centers[C], ctx[C,2W], negs[C,K], pmask[C,2W])."""
-    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
-    centers, ctx, pmask = _window(C, W, n, kept, ksent, k_shrink, base,
-                                  n_kept)
-    draw = jax.random.randint(k_idx, (C, K), 0, neg_prob.shape[0])
-    keep_draw = jax.random.uniform(k_keep, (C, K)) < neg_prob[draw]
-    negs = jnp.where(keep_draw, draw, neg_alias[draw])
-    return centers, ctx, negs, pmask
+def _pad_stream(C, W, kept, ksent):
+    """Pad the compacted stream so banded slices never clamp: W on the
+    left, C+W on the right (a clamped ``dynamic_slice`` would shift the
+    whole band and misalign valid centers on the epoch's tail step).
+    Padding carries sentence -2, which never matches a real sentence,
+    so every padded position is masked out."""
+    return (jnp.pad(kept, (W, C + W)),
+            jnp.pad(ksent, (W, C + W), constant_values=-2))
 
 
-def _sgns_loss_and_grads(v, u_ctx, u_neg, pmask):
-    """Shared SGNS objective over gathered rows: sigmoid xent at label
-    1 for context pairs (masked) and label 0 for the per-center shared
-    negatives (weighted by the center's valid-pair count). Returns
-    (loss, g_v, g_ctx, g_neg)."""
+def _band_former(C, W, n_kept, kept_pad, ksent_pad, k_shrink, base):
+    """The banded window former: C consecutive kept positions as
+    centers; their contexts all lie in the contiguous band
+    ``kept[base-W : base+C+W]`` (C+2W tokens), and the per-(center,
+    offset) validity — in-stream, same sentence, within the per-center
+    shrunk window (the word2vec trick, ref: wordembedding.cpp Train
+    window sampling) — is a mask over shifted slices of the band.
+    Returns (centers[C], band[C+2W], pmask[C,2W])."""
+    offs = [o for o in range(-W, W + 1) if o != 0]
+    idx = base + jnp.arange(C, dtype=jnp.int32)
+    centers = jax.lax.dynamic_slice_in_dim(kept_pad, base + W, C)
+    csent = jax.lax.dynamic_slice_in_dim(ksent_pad, base + W, C)
+    center_ok = (idx < n_kept) & (csent >= 0)
+    shrink = jax.random.randint(k_shrink, (C,), 1, W + 1)
+    band = jax.lax.dynamic_slice_in_dim(kept_pad, base, C + 2 * W)
+    band_sent = jax.lax.dynamic_slice_in_dim(ksent_pad, base, C + 2 * W)
+    masks = []
+    for off in offs:
+        p = idx + off
+        inb = (p >= 0) & (p < n_kept)
+        s = jax.lax.dynamic_slice_in_dim(band_sent, W + off, C)
+        masks.append(inb & (s == csent) & (abs(off) <= shrink)
+                     & center_ok)
+    pmask = jnp.stack(masks, axis=1).astype(jnp.float32)
+    return centers, band, pmask
+
+
+def _draw_negs(C, K, B, neg_prob, neg_alias, k_idx, k_keep):
+    """K negatives per block of B consecutive centers via the alias
+    tables — B=1 is the per-center draw (and reproduces the round-3
+    draws bit-exactly). Returns negs[C//B, K]."""
+    nb = C // B
+    draw = jax.random.randint(k_idx, (nb, K), 0, neg_prob.shape[0])
+    keep_draw = jax.random.uniform(k_keep, (nb, K)) < neg_prob[draw]
+    return jnp.where(keep_draw, draw, neg_alias[draw])
+
+
+def _banded_sgns_loss_and_grads(v, u_band, u_neg, pmask):
+    """SGNS objective in banded form: context logits are dot products
+    of each center row against 2W shifted slices of the band's OUTPUT
+    rows; sigmoid xent at label 1 (masked) plus label 0 for the
+    block-shared negatives (weighted by the center's valid-pair count).
+    Returns (loss, g_v, g_band, g_neg)."""
+    C, W = pmask.shape[0], pmask.shape[1] // 2
+    nb, B = u_neg.shape[0], C // u_neg.shape[0]
+    offs = [o for o in range(-W, W + 1) if o != 0]
     nvalid = pmask.sum(axis=1)
 
-    def loss_fn(v, u_ctx, u_neg):
-        pos = jnp.clip(jnp.einsum("cd,cwd->cw", v, u_ctx),
-                       -_MAX_EXP, _MAX_EXP)
-        neg = jnp.clip(jnp.einsum("cd,ckd->ck", v, u_neg),
+    def loss_fn(v, u_band, u_neg):
+        pos = jnp.stack(
+            [jnp.sum(v * jax.lax.dynamic_slice_in_dim(u_band, W + off, C),
+                     axis=-1) for off in offs], axis=1)
+        pos = jnp.clip(pos, -_MAX_EXP, _MAX_EXP)
+        vb = v.reshape(nb, B, v.shape[-1])
+        neg = jnp.clip(jnp.einsum("nbd,nkd->nbk", vb, u_neg),
                        -_MAX_EXP, _MAX_EXP)
         xp = _sigmoid_xent(pos, 1.0) * pmask
-        xn = _sigmoid_xent(neg, 0.0) * nvalid[:, None]
+        xn = _sigmoid_xent(neg, 0.0) * nvalid.reshape(nb, B)[:, :, None]
         return xp.sum() + xn.sum()
 
     loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
-        v, u_ctx, u_neg)
+        v, u_band, u_neg)
     return (loss,) + grads
 
 
-def _cbow_loss_and_grads(u_ctx, u_out, pmask):
-    """CBOW objective over gathered rows: the masked mean of the
-    window's INPUT rows predicts [center | K negatives] from the OUTPUT
-    table — one example per center (ref: wordembedding.cpp CBOW
-    branch; gradient through the mean is the mathematically consistent
-    1/|window| form, as on the host-batch path). ``u_ctx`` [C, 2W, D],
-    ``u_out`` [C, 1+K, D]. Returns (loss, g_ctx, g_out, examples)."""
+def _banded_cbow_loss_and_grads(u_band, u_center, u_neg, pmask):
+    """CBOW objective in banded form: the masked mean of the window's
+    INPUT rows (shifted band slices) predicts the center and the
+    block-shared negatives from the OUTPUT table — one example per
+    center (ref: wordembedding.cpp CBOW branch; gradient through the
+    mean is the 1/|window| form, as on the host-batch path).
+    ``u_band`` [C+2W, D] INPUT rows, ``u_center`` [C, D] and ``u_neg``
+    [C//B, K, D] OUTPUT rows. Returns
+    (loss, g_band, g_center, g_neg, examples)."""
+    C, W = pmask.shape[0], pmask.shape[1] // 2
+    nb, B = u_neg.shape[0], C // u_neg.shape[0]
+    offs = [o for o in range(-W, W + 1) if o != 0]
     nvalid = pmask.sum(axis=1)
     has_ctx = (nvalid > 0).astype(jnp.float32)
-    k = u_out.shape[1] - 1
 
-    def loss_fn(u_ctx, u_out):
+    def loss_fn(u_band, u_center, u_neg):
         denom = jnp.maximum(nvalid, 1.0)
-        v = (u_ctx * pmask[..., None]).sum(axis=1) / denom[:, None]
-        logits = jnp.clip(jnp.einsum("cd,csd->cs", v, u_out),
-                          -_MAX_EXP, _MAX_EXP)
-        labels = jnp.concatenate(
-            [jnp.ones((1, 1)), jnp.zeros((1, k))], axis=1)
-        return jnp.sum(_sigmoid_xent(logits, labels)
-                       * has_ctx[:, None])
+        acc = 0.0
+        for w, off in enumerate(offs):
+            acc = acc + pmask[:, w:w + 1] * \
+                jax.lax.dynamic_slice_in_dim(u_band, W + off, C)
+        vmean = acc / denom[:, None]
+        pos = jnp.clip(jnp.sum(vmean * u_center, axis=-1),
+                       -_MAX_EXP, _MAX_EXP)
+        vb = vmean.reshape(nb, B, vmean.shape[-1])
+        neg = jnp.clip(jnp.einsum("nbd,nkd->nbk", vb, u_neg),
+                       -_MAX_EXP, _MAX_EXP)
+        xp = _sigmoid_xent(pos, 1.0) * has_ctx
+        xn = _sigmoid_xent(neg, 0.0) \
+            * has_ctx.reshape(nb, B)[:, :, None]
+        return xp.sum() + xn.sum()
 
-    loss, (g_ctx, g_out) = jax.value_and_grad(
-        loss_fn, argnums=(0, 1))(u_ctx, u_out)
-    return loss, g_ctx, g_out, has_ctx.sum()
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+        u_band, u_center, u_neg)
+    return (loss,) + grads + (has_ctx.sum(),)
 
 
-def _apply_step(C, W, K, n, cbow, emb_in, emb_out, kept, ksent,
-                neg_prob, neg_alias, key, base, lr, n_kept):
-    """One full in-jit training step against local table arrays —
-    window former + objective + the two scatter-add updates. Shared by
-    the single-device group scan and the MA (model-average) mesh path
-    so the update math cannot diverge between them. Returns
+def _apply_step(C, W, K, cbow, emb_in, emb_out, kept_pad, ksent_pad,
+                neg_prob, neg_alias, key, base, lr, n_kept,
+                neg_block: int = 1):
+    """One full in-jit banded training step against local table arrays
+    — band former + objective + scatter-add updates of C+2W band rows,
+    C center rows and C//B negative rows (vs the C*(2W+K) scattered
+    rows of the row-matrix form). Shared by the single-device group
+    scan and the MA mesh path so the update math cannot diverge.
+    ``kept_pad``/``ksent_pad`` must come from ``_pad_stream``. Returns
     (emb_in, emb_out, loss, examples)."""
-    centers, ctx, negs, pmask = _window_and_negs(
-        C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base, n_kept)
+    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+    centers, band, pmask = _band_former(C, W, n_kept, kept_pad,
+                                        ksent_pad, k_shrink, base)
+    negs = _draw_negs(C, K, neg_block, neg_prob, neg_alias,
+                      k_idx, k_keep)
     if cbow:
         # window (input table) -> [center | negs] (output table)
-        u_ctx = emb_in[ctx]                       # [C, 2W, D]
-        out_ids = jnp.concatenate([centers[:, None], negs], axis=1)
-        u_out = emb_out[out_ids]                  # [C, 1+K, D]
-        loss, g_ctx, g_out, examples = _cbow_loss_and_grads(
-            u_ctx, u_out, pmask)
-        emb_in = emb_in.at[ctx].add(-lr * g_ctx)
-        emb_out = emb_out.at[out_ids].add(-lr * g_out)
+        u_band = emb_in[band]                 # [C+2W, D]
+        u_center = emb_out[centers]           # [C, D]
+        u_neg = emb_out[negs]                 # [C//B, K, D]
+        loss, g_band, g_center, g_neg, examples = \
+            _banded_cbow_loss_and_grads(u_band, u_center, u_neg, pmask)
+        emb_in = emb_in.at[band].add(-lr * g_band)
+        emb_out = emb_out.at[centers].add(-lr * g_center)
+        emb_out = emb_out.at[negs].add(-lr * g_neg)
         return emb_in, emb_out, loss, examples
-    v = emb_in[centers]          # [C, D]
-    u_ctx = emb_out[ctx]         # [C, 2W, D]
-    u_neg = emb_out[negs]        # [C, K, D]
-    loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
-        v, u_ctx, u_neg, pmask)
+    v = emb_in[centers]              # [C, D]
+    u_band = emb_out[band]           # [C+2W, D]
+    u_neg = emb_out[negs]            # [C//B, K, D]
+    loss, g_v, g_band, g_neg = _banded_sgns_loss_and_grads(
+        v, u_band, u_neg, pmask)
     emb_in = emb_in.at[centers].add(-lr * g_v)
-    out_ids = jnp.concatenate([ctx, negs], axis=1)
-    g_out = jnp.concatenate([g_ctx, g_neg], axis=1)
-    emb_out = emb_out.at[out_ids].add(-lr * g_out)
+    emb_out = emb_out.at[band].add(-lr * g_band)
+    emb_out = emb_out.at[negs].add(-lr * g_neg)
     return emb_in, emb_out, loss, pmask.sum()
 
 
-def _make_group(step):
+def _make_group(step, pad=None):
     """The scan driver shared by every device group program: carry the
     tables + PRNG key through G steps, sum losses/examples, return the
-    advanced key, donate the table buffers."""
+    advanced key, donate the table buffers. ``pad=(C, W)`` pads the
+    kept stream for the banded steps at group entry (one ~24 MB fused
+    copy per dispatch — the per-step slices then never clamp); the HS
+    path passes None and consumes the stream unpadded."""
 
     def group(emb_in, emb_out, kept, ksent, aux1, aux2,
               key, bases, lrs, n_kept):
+        if pad is not None:
+            kept, ksent = _pad_stream(pad[0], pad[1], kept, ksent)
+
         def body(carry, xs):
             emb_in, emb_out, key = carry
             base, lr = xs
@@ -246,18 +319,19 @@ def _group_fn_hs(C: int, W: int, n: int):
 # shape (C, window, negative, corpus length, mode) shares one compiled
 # group program — a warmup trainer's compile pays for the timed one.
 @functools.lru_cache(maxsize=None)
-def _group_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
-    def step(emb_in, emb_out, kept, ksent, neg_prob, neg_alias,
+def _group_fn(C: int, W: int, K: int, cbow: bool = False,
+              neg_block: int = 1):
+    def step(emb_in, emb_out, kept_pad, ksent_pad, neg_prob, neg_alias,
              key, base, lr, n_kept):
-        return _apply_step(C, W, K, n, cbow, emb_in, emb_out, kept,
-                           ksent, neg_prob, neg_alias, key, base, lr,
-                           n_kept)
+        return _apply_step(C, W, K, cbow, emb_in, emb_out, kept_pad,
+                           ksent_pad, neg_prob, neg_alias, key, base,
+                           lr, n_kept, neg_block=neg_block)
 
-    return _make_group(step)
+    return _make_group(step, pad=(C, W))
 
 
 @functools.lru_cache(maxsize=None)
-def _ma_group_fn(mesh, C: int, W: int, K: int, n_local: int):
+def _ma_group_fn(mesh, C: int, W: int, K: int, neg_block: int = 1):
     """Model-average (``-ma``) word2vec over a device mesh: each device
     scans G local SGNS steps against its own REPLICA of the embedding
     tables on its own CORPUS SHARD, then the replicas average with
@@ -290,14 +364,18 @@ def _ma_group_fn(mesh, C: int, W: int, K: int, n_local: int):
             pcast = jax.lax.pvary
         emb_in = pcast(emb_in, axis)
         emb_out = pcast(emb_out, axis)
+        # Pad each device's LOCAL stream for the banded slices (inside
+        # shard_map, so this is a per-shard local op).
+        kept_pad, ksent_pad = _pad_stream(C, W, kept, ksent)
 
         def body(carry, xs):
             emb_in, emb_out, key = carry
             base, lr = xs
             key, sub = jax.random.split(key)
             emb_in, emb_out, loss, pairs = _apply_step(
-                C, W, K, n_local, False, emb_in, emb_out, kept, ksent,
-                neg_prob, neg_alias, sub, base, lr, n_kept)
+                C, W, K, False, emb_in, emb_out, kept_pad,
+                ksent_pad, neg_prob, neg_alias, sub, base, lr, n_kept,
+                neg_block=neg_block)
             return (emb_in, emb_out, key), (loss, pairs)
 
         (emb_in, emb_out, key), (losses, pairs) = jax.lax.scan(
@@ -375,9 +453,12 @@ class DeviceCorpusTrainer:
             # aux slots: the Huffman path/code tables.
             self._aux = (model._points_dev, model._codes_dev)
         else:
+            B = max(int(getattr(config, "neg_block", 1)), 1)
+            if self._C % B:
+                raise ValueError("neg_block must divide centers_per_step")
             self._group = _group_fn(self._C, config.window,
-                                    config.negative, self._n_tokens,
-                                    bool(config.cbow))
+                                    config.negative, bool(config.cbow),
+                                    B)
             self._aux = (model._neg_prob_dev, model._neg_alias_dev)
         # Post-subsampling tokens actually trained (centers), across
         # epochs — the exact basis for utilization accounting.
@@ -429,42 +510,58 @@ class DeviceCorpusTrainer:
 
 
 @functools.lru_cache(maxsize=None)
-def _block_ids_fn(C: int, W: int, K: int, n: int, cbow: bool = False):
+def _block_ids_fn(C: int, W: int, K: int, cbow: bool = False,
+                  neg_block: int = 1):
     """Jitted block preparation for the PS pipeline: the INPUT-table id
-    block, the OUTPUT-table id block, and the pair validity mask — all
-    device-resident, ready to hand to the tables as DEVICE keys.
-    Skip-gram: in=centers [C], out=[ctx | negs] [C, 2W+K].
-    CBOW: in=ctx [C, 2W], out=[center | negs] [C, 1+K]."""
+    block, the OUTPUT-table id block (flat), and the pair validity mask
+    — all device-resident, ready to hand to the tables as DEVICE keys.
+    Takes the PADDED stream (pad once per epoch, not per step).
+    Banded form: skip-gram in=centers [C],
+    out=[band (C+2W) | negs (C//B*K)]; CBOW in=band [C+2W],
+    out=[centers (C) | negs (C//B*K)]. The band replaces the [C, 2W]
+    context id matrix — 2W-fold fewer pulled/pushed rows."""
 
-    def ids(kept, ksent, neg_prob, neg_alias, key, base, n_kept):
-        centers, ctx, negs, pmask = _window_and_negs(
-            C, W, K, n, kept, ksent, neg_prob, neg_alias, key, base,
-            n_kept)
+    def ids(kept_pad, ksent_pad, neg_prob, neg_alias, key, base,
+            n_kept):
+        k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+        centers, band, pmask = _band_former(C, W, n_kept, kept_pad,
+                                            ksent_pad, k_shrink, base)
+        negs = _draw_negs(C, K, neg_block, neg_prob, neg_alias,
+                          k_idx, k_keep)
         if cbow:
-            return ctx, jnp.concatenate([centers[:, None], negs],
-                                        axis=1), pmask
-        return centers, jnp.concatenate([ctx, negs], axis=1), pmask
+            return band, jnp.concatenate([centers, negs.reshape(-1)]), \
+                pmask
+        return centers, jnp.concatenate([band, negs.reshape(-1)]), pmask
 
     return jax.jit(ids)
 
 
 @functools.lru_cache(maxsize=None)
-def _block_step_fn(C: int, W: int, K: int, cbow: bool = False):
-    """Jitted PS block step over PULLED rows: returns the PUSH deltas
+def _block_step_fn(C: int, W: int, K: int, cbow: bool = False,
+                   neg_block: int = 1):
+    """Jitted PS block step over PULLED rows (banded layout from
+    ``_block_ids_fn``): returns the PUSH deltas
     ``-lr*grad/num_workers`` (the reference's (new-old)/num_workers with
     one local step, ref: communicator.cpp:157-249) plus loss/examples."""
+    nb = C // neg_block
 
     def step(v, u, pmask, lr_scaled):
         if cbow:
-            # v = pulled INPUT window rows [C, 2W, D]; u = pulled OUTPUT
-            # [center | negs] rows [C, 1+K, D].
-            loss, g_ctx, g_out, examples = _cbow_loss_and_grads(
-                v, u, pmask)
-            return (-lr_scaled * g_ctx, -lr_scaled * g_out, loss,
-                    examples)
-        loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
-            v, u[:, :2 * W], u[:, 2 * W:], pmask)
-        g_u = jnp.concatenate([g_ctx, g_neg], axis=1)
+            # v = pulled INPUT band rows [C+2W, D]; u = pulled OUTPUT
+            # [centers | negs] rows [C + nb*K, D].
+            u_center = u[:C]
+            u_neg = u[C:].reshape(nb, K, -1)
+            loss, g_band, g_center, g_neg, examples = \
+                _banded_cbow_loss_and_grads(v, u_center, u_neg, pmask)
+            g_out = jnp.concatenate(
+                [g_center, g_neg.reshape(nb * K, -1)])
+            return -lr_scaled * g_band, -lr_scaled * g_out, loss, examples
+        # v = pulled center rows [C, D]; u = [band | negs] rows.
+        u_band = u[:C + 2 * W]
+        u_neg = u[C + 2 * W:].reshape(nb, K, -1)
+        loss, g_v, g_band, g_neg = _banded_sgns_loss_and_grads(
+            v, u_band, u_neg, pmask)
+        g_u = jnp.concatenate([g_band, g_neg.reshape(nb * K, -1)])
         return -lr_scaled * g_v, -lr_scaled * g_u, loss, pmask.sum()
 
     return jax.jit(step)
@@ -509,11 +606,16 @@ class PSDeviceCorpusTrainer:
             # in-jit, so upload them once.
             model._neg_prob_dev = jnp.asarray(model._neg_prob_host)
             model._neg_alias_dev = jnp.asarray(model._neg_alias_host)
+        B = max(int(getattr(config, "neg_block", 1)), 1)
+        if self._C % B:
+            raise ValueError("neg_block must divide centers_per_step")
         self._ids = _block_ids_fn(self._C, config.window,
-                                  config.negative, self._n_tokens,
-                                  bool(config.cbow))
+                                  config.negative, bool(config.cbow), B)
+        self._pad = jax.jit(functools.partial(_pad_stream, self._C,
+                                              config.window))
         self._step = _block_step_fn(self._C, config.window,
-                                    config.negative, bool(config.cbow))
+                                    config.negative, bool(config.cbow),
+                                    B)
         self.kept_words_trained = 0
 
     def train_epoch(self, seed: int, block_hook=None,
@@ -527,6 +629,10 @@ class PSDeviceCorpusTrainer:
         key = jax.random.PRNGKey(seed)
         key, prep_key = jax.random.split(key)
         kept, ksent, n_kept_dev = self._corpus.prep_epoch(prep_key)
+        # Pad ONCE per epoch; the per-step ids program then slices the
+        # padded stream directly (padding per step would re-copy the
+        # whole ~24 MB stream every block).
+        kept_pad, ksent_pad = self._pad(kept, ksent)
         n_kept = int(n_kept_dev)
         steps = max(math.ceil(n_kept / C), 1)
         if max_steps:
@@ -541,8 +647,9 @@ class PSDeviceCorpusTrainer:
             # block [C, 2W] (CBOW); out_ids: [ctx | negs] or
             # [center | negs] — see _block_ids_fn.
             in_ids, out_ids, pmask = self._ids(
-                kept, ksent, model._neg_prob_dev, model._neg_alias_dev,
-                step_key, np.int32(s * C), n_kept_dev)
+                kept_pad, ksent_pad, model._neg_prob_dev,
+                model._neg_alias_dev, step_key, np.int32(s * C),
+                n_kept_dev)
             # Device-key pulls ride the worker->server actor round trip;
             # the replies are lazy device arrays (no host sync).
             mid_in = in_table.get_rows_device_async(in_ids)

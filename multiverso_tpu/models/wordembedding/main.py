@@ -41,6 +41,9 @@ define_bool("hs", False, "hierarchical softmax instead of negative "
                          "sampling")
 define_bool("use_ps", False, "train through the parameter server")
 define_int("batch_size", 4096, "pairs per jitted step")
+define_int("neg_block", 1, "device pipelines: share one draw of K "
+           "negatives across this many consecutive centers (1 = "
+           "per-center draws; larger divides negative row traffic)")
 define_bool("is_pipeline", True, "overlap loading with training")
 define_string("stopwords", "", "optional stopwords file (one word per "
               "line) filtered out of the vocabulary — the reference "
@@ -56,7 +59,8 @@ def run(argv=None) -> Word2Vec:
         min_count=get_flag("min_count"), sample=get_flag("sample"),
         init_learning_rate=get_flag("init_learning_rate"),
         cbow=get_flag("cbow"), hs=get_flag("hs"),
-        batch_size=get_flag("batch_size"), use_ps=get_flag("use_ps"))
+        batch_size=get_flag("batch_size"), use_ps=get_flag("use_ps"),
+        neg_block=get_flag("neg_block"))
     train_file = get_flag("train_file")
     if not train_file:
         raise SystemExit("need -train_file=<corpus>")

@@ -59,7 +59,8 @@ class Word2VecConfig:
                  sample: float = 1e-3, init_learning_rate: float = 0.025,
                  cbow: bool = False, hs: bool = False,
                  batch_size: int = 4096, seed: int = 1,
-                 use_ps: bool = False, batch_group: int = 16):
+                 use_ps: bool = False, batch_group: int = 16,
+                 neg_block: int = 1):
         self.embedding_size = embedding_size
         self.window = window
         self.negative = negative
@@ -76,6 +77,11 @@ class Word2VecConfig:
         # K-step on-device loop that amortizes per-call dispatch latency.
         # 1 disables grouping.
         self.batch_group = batch_group
+        # Device-pipeline negative sharing: one draw of K negatives per
+        # block of this many consecutive centers (1 = per-center, the
+        # round-3 behavior; expected gradient unchanged, negative row
+        # traffic divided by the block factor).
+        self.neg_block = neg_block
 
 
 def build_alias(probs: np.ndarray):

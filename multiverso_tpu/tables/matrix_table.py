@@ -447,7 +447,12 @@ class MatrixWorker(WorkerTable):
             return out
 
         # Row-id requests: bucket rows by owning server
-        # (ref: matrix_table.cpp:267-276).
+        # (ref: matrix_table.cpp:267-276). Defense in depth for raw-API
+        # callers: a negative id in a VECTOR would bucket to server -1
+        # (misrouted shard, silent wrap or a hang) — reject here too.
+        CHECK(keys.size == 0 or (int(keys.min()) >= 0
+                                 and int(keys.max()) < self.num_row),
+              "row ids out of range [0, num_row)")
         is_add = msg_type == MsgType.Request_Add
         dest = np.minimum(keys // self._row_length, self._num_server - 1)
         values = dev_values = None

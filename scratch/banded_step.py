@@ -1,0 +1,297 @@
+"""Prototype: banded SGNS step exploiting window overlap.
+
+Context positions of C consecutive centers span a contiguous band
+kept[base-W : base+C+W]; gather those C+2W rows ONCE and form the 2W
+context logits as shifted slices — 2W-fold less gather/scatter traffic
+than the [C, 2W] row-gather formulation. Verify numerics against the
+existing _apply_step, then slope-time it.
+"""
+import functools
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+
+from multiverso_tpu.models.wordembedding.model import _MAX_EXP, _sigmoid_xent
+from multiverso_tpu.models.wordembedding.device_train import (
+    _window_and_negs, _apply_step)
+
+
+def banded_step(C, W, K, n, emb_in, emb_out, kept_pad, ksent_pad,
+                neg_prob, neg_alias, key, base, lr, n_kept,
+                sort_scatter=True):
+    """kept_pad/ksent_pad are padded with W sentinel entries on both
+    sides (sentinel token 0 with sentence -2), so the band slice is
+    always in range; position p of the unpadded stream is p+W here."""
+    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+    idx = base + jnp.arange(C, dtype=jnp.int32)       # center positions
+    safe = jnp.minimum(idx, n - 1)
+    centers = jax.lax.dynamic_slice_in_dim(kept_pad, base + W, C)
+    csent = jax.lax.dynamic_slice_in_dim(ksent_pad, base + W, C)
+    center_ok = (idx < n_kept) & (csent >= 0)
+    shrink = jax.random.randint(k_shrink, (C,), 1, W + 1)
+
+    band = jax.lax.dynamic_slice_in_dim(kept_pad, base, C + 2 * W)
+    band_sent = jax.lax.dynamic_slice_in_dim(ksent_pad, base, C + 2 * W)
+
+    # negatives per center, alias method
+    draw = jax.random.randint(k_idx, (C, K), 0, neg_prob.shape[0])
+    keep_draw = jax.random.uniform(k_keep, (C, K)) < neg_prob[draw]
+    negs = jnp.where(keep_draw, draw, neg_alias[draw])
+
+    v = emb_in[centers]                    # [C, D]
+    u_band = emb_out[band]                 # [C+2W, D]
+    u_neg = emb_out[negs]                  # [C, K, D]
+
+    offs = [o for o in range(-W, W + 1) if o != 0]
+    abs_offs = np.abs(np.array(offs))
+
+    # Validity per (center, offset): in-band position p = c + W + off;
+    # absolute stream position = idx + off must be in [0, n_kept) and
+    # same sentence, |off| <= shrink, and the center itself valid.
+    def pos_valid(w):
+        off = offs[w]
+        p = idx + off
+        inb = (p >= 0) & (p < n_kept)
+        s = jax.lax.dynamic_slice_in_dim(band_sent, W + off, C)
+        return (inb & (s == csent) & (abs_offs[w] <= shrink)
+                & center_ok).astype(jnp.float32)
+
+    pmask = jnp.stack([pos_valid(w) for w in range(2 * W)], axis=1)
+    nvalid = pmask.sum(axis=1)
+
+    def loss_fn(v, u_band, u_neg):
+        pos_logits = []
+        for w, off in enumerate(offs):
+            u_off = jax.lax.dynamic_slice_in_dim(u_band, W + off, C)
+            pos_logits.append(jnp.sum(v * u_off, axis=-1))
+        pos = jnp.clip(jnp.stack(pos_logits, axis=1), -_MAX_EXP, _MAX_EXP)
+        neg = jnp.clip(jnp.einsum("cd,ckd->ck", v, u_neg),
+                       -_MAX_EXP, _MAX_EXP)
+        xp = _sigmoid_xent(pos, 1.0) * pmask
+        xn = _sigmoid_xent(neg, 0.0) * nvalid[:, None]
+        return xp.sum() + xn.sum()
+
+    loss, (g_v, g_band, g_neg) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1, 2))(v, u_band, u_neg)
+
+    emb_in = emb_in.at[centers].add(-lr * g_v)
+    if sort_scatter:
+        flat_ids = jnp.concatenate([band, negs.reshape(-1)])
+        flat_g = jnp.concatenate(
+            [g_band, g_neg.reshape(-1, g_neg.shape[-1])])
+        order = jnp.argsort(flat_ids)
+        emb_out = emb_out.at[flat_ids[order]].add(
+            -lr * flat_g[order], indices_are_sorted=True)
+    else:
+        emb_out = emb_out.at[band].add(-lr * g_band)
+        emb_out = emb_out.at[negs].add(-lr * g_neg)
+    return emb_in, emb_out, loss, pmask.sum()
+
+
+def pad_stream(kept, ksent, W, C):
+    # Left pad W; right pad C+W so the band slice NEVER clamps (a
+    # clamped dynamic_slice shifts the whole window and misaligns valid
+    # centers on the epoch's tail step). Padding carries sentence -2:
+    # never matches a real sentence, so everything there is masked.
+    kp = jnp.pad(kept, (W, C + W))
+    ks = jnp.pad(ksent, (W, C + W), constant_values=-2)
+    return kp, ks
+
+
+# ---------- numeric parity on small shapes (CPU-friendly sizes) ----------
+def check_numerics():
+    V, D, n = 500, 16, 4000
+    C, W, K = 64, 5, 5
+    key = jax.random.PRNGKey(7)
+    kept = jax.random.randint(key, (n,), 0, V, jnp.int32)
+    ksent = jnp.repeat(jnp.arange(n // 20, dtype=jnp.int32), 20)[:n]
+    neg_prob = jnp.ones((V,)) * 0.5
+    neg_alias = jax.random.randint(key, (V,), 0, V, jnp.int32)
+    emb_in = jax.random.normal(key, (V, D), jnp.float32) * 0.1
+    emb_out = jax.random.normal(jax.random.PRNGKey(8), (V, D)) * 0.1
+    n_kept = jnp.int32(n - 100)
+    base = jnp.int32(1200)
+    lr = jnp.float32(0.025)
+    step_key = jax.random.PRNGKey(42)
+
+    ref = _apply_step(C, W, K, n, False, emb_in, emb_out, kept, ksent,
+                      neg_prob, neg_alias, step_key, base, lr, n_kept)
+    kp, ks = pad_stream(kept, ksent, W, C)
+    new = banded_step(C, W, K, n, emb_in, emb_out, kp, ks,
+                      neg_prob, neg_alias, step_key, base, lr, n_kept)
+    for name, a, b in (("emb_in", ref[0], new[0]),
+                       ("emb_out", ref[1], new[1]),
+                       ("loss", ref[2], new[2]),
+                       ("pairs", ref[3], new[3])):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        diff = np.max(np.abs(a - b)) if a.shape else abs(a - b)
+        print(f"  {name}: max|diff| = {diff:.3e}")
+        assert diff < 2e-4, (name, diff)
+    # also check a boundary base (start of stream)
+    for b0 in (0, n - C // 2):
+        ref = _apply_step(C, W, K, n, False, emb_in, emb_out, kept,
+                          ksent, neg_prob, neg_alias, step_key,
+                          jnp.int32(b0), lr, n_kept)
+        new = banded_step(C, W, K, n, emb_in, emb_out, kp, ks, neg_prob,
+                          neg_alias, step_key, jnp.int32(b0), lr, n_kept)
+        d = float(np.max(np.abs(np.asarray(ref[1]) - np.asarray(new[1]))))
+        print(f"  base={b0}: emb_out max|diff| = {d:.3e}")
+        assert d < 2e-4
+
+
+print("numeric parity:")
+check_numerics()
+print("OK")
+
+# ---------- speed at bench shapes ----------
+V, D = 1_013_245, 128
+N = 6_000_000
+C, W, K = 32768, 5, 5
+key = jax.random.PRNGKey(0)
+kept = jax.random.randint(key, (N,), 0, V, jnp.int32)
+ksent = jnp.repeat(jnp.arange(N // 40, dtype=jnp.int32), 40)[:N]
+kp, ks = pad_stream(kept, ksent, W, C)
+neg_prob = jax.random.uniform(key, (V,))
+neg_alias = jax.random.randint(key, (V,), 0, V, jnp.int32)
+n_kept = jnp.int32(N - 1000)
+
+
+def force(x):
+    return float(jnp.ravel(x)[0])
+
+
+def slope_time(build, lo=4, hi=16):
+    def run(G):
+        fn = build(G)
+        emb_in = jnp.zeros((V, D), jnp.float32)
+        emb_out = jnp.zeros((V, D), jnp.float32)
+        out = fn(emb_in, emb_out, jax.random.PRNGKey(1))
+        force(out)
+        best = float("inf")
+        for _ in range(3):
+            emb_in = jnp.zeros((V, D), jnp.float32)
+            emb_out = jnp.zeros((V, D), jnp.float32)
+            force(emb_in); force(emb_out)
+            t0 = time.perf_counter()
+            out = fn(emb_in, emb_out, jax.random.PRNGKey(2))
+            force(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+    t_lo, t_hi = run(lo), run(hi)
+    return (t_hi - t_lo) / (hi - lo)
+
+
+def build_banded(sort_scatter):
+    def build(G):
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=3)
+        def f(emb_in, emb_out, key, g):
+            def body(carry, base):
+                emb_in, emb_out, key = carry
+                key, sub = jax.random.split(key)
+                emb_in, emb_out, loss, pairs = banded_step(
+                    C, W, K, N, emb_in, emb_out, kp, ks, neg_prob,
+                    neg_alias, sub, base, jnp.float32(0.01), n_kept,
+                    sort_scatter=sort_scatter)
+                return (emb_in, emb_out, key), loss
+            bases = jnp.arange(g, dtype=jnp.int32) * C
+            (emb_in, emb_out, key), losses = jax.lax.scan(
+                body, (emb_in, emb_out, key), bases)
+            return losses.sum() + emb_in[0, 0] + emb_out[0, 0]
+        return lambda a, b, k2: f(a, b, k2, G)
+    return build
+
+
+for sort in (False, True):
+    s = slope_time(build_banded(sort))
+    print(f"banded sort={sort}: {s*1e3:8.2f} ms/step  "
+          f"{C/s/1e6:6.2f} M centers/s")
+
+
+# ---------- variant: negatives shared across blocks of B centers ----------
+def banded_step_blockneg(C, W, K, B, n, emb_in, emb_out, kept_pad,
+                         ksent_pad, neg_prob, neg_alias, key, base, lr,
+                         n_kept):
+    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+    idx = base + jnp.arange(C, dtype=jnp.int32)
+    centers = jax.lax.dynamic_slice_in_dim(kept_pad, base + W, C)
+    csent = jax.lax.dynamic_slice_in_dim(ksent_pad, base + W, C)
+    center_ok = (idx < n_kept) & (csent >= 0)
+    shrink = jax.random.randint(k_shrink, (C,), 1, W + 1)
+    band = jax.lax.dynamic_slice_in_dim(kept_pad, base, C + 2 * W)
+    band_sent = jax.lax.dynamic_slice_in_dim(ksent_pad, base, C + 2 * W)
+    nb = C // B
+    draw = jax.random.randint(k_idx, (nb, K), 0, neg_prob.shape[0])
+    keep_draw = jax.random.uniform(k_keep, (nb, K)) < neg_prob[draw]
+    negs = jnp.where(keep_draw, draw, neg_alias[draw])   # [nb, K]
+
+    v = emb_in[centers]
+    u_band = emb_out[band]
+    u_neg = emb_out[negs]                                # [nb, K, D]
+
+    offs = [o for o in range(-W, W + 1) if o != 0]
+    abs_offs = np.abs(np.array(offs))
+
+    def pos_valid(w):
+        off = offs[w]
+        p = idx + off
+        inb = (p >= 0) & (p < n_kept)
+        s = jax.lax.dynamic_slice_in_dim(band_sent, W + off, C)
+        return (inb & (s == csent) & (abs_offs[w] <= shrink)
+                & center_ok).astype(jnp.float32)
+
+    pmask = jnp.stack([pos_valid(w) for w in range(2 * W)], axis=1)
+    nvalid = pmask.sum(axis=1)
+
+    def loss_fn(v, u_band, u_neg):
+        pos_logits = []
+        for w, off in enumerate(offs):
+            u_off = jax.lax.dynamic_slice_in_dim(u_band, W + off, C)
+            pos_logits.append(jnp.sum(v * u_off, axis=-1))
+        pos = jnp.clip(jnp.stack(pos_logits, axis=1), -_MAX_EXP, _MAX_EXP)
+        vb = v.reshape(nb, B, v.shape[-1])
+        neg = jnp.clip(jnp.einsum("nbd,nkd->nbk", vb, u_neg),
+                       -_MAX_EXP, _MAX_EXP)
+        xp = _sigmoid_xent(pos, 1.0) * pmask
+        xn = _sigmoid_xent(neg, 0.0) * nvalid.reshape(nb, B)[:, :, None]
+        return xp.sum() + xn.sum()
+
+    loss, (g_v, g_band, g_neg) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1, 2))(v, u_band, u_neg)
+    emb_in = emb_in.at[centers].add(-lr * g_v)
+    emb_out = emb_out.at[band].add(-lr * g_band)
+    emb_out = emb_out.at[negs].add(-lr * g_neg)
+    return emb_in, emb_out, loss, pmask.sum()
+
+
+def build_blockneg(B):
+    def build(G):
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=3)
+        def f(emb_in, emb_out, key, g):
+            def body(carry, base):
+                emb_in, emb_out, key = carry
+                key, sub = jax.random.split(key)
+                emb_in, emb_out, loss, pairs = banded_step_blockneg(
+                    C, W, K, B, N, emb_in, emb_out, kp, ks, neg_prob,
+                    neg_alias, sub, base, jnp.float32(0.01), n_kept)
+                return (emb_in, emb_out, key), loss
+            bases = jnp.arange(g, dtype=jnp.int32) * C
+            (emb_in, emb_out, key), losses = jax.lax.scan(
+                body, (emb_in, emb_out, key), bases)
+            return losses.sum() + emb_in[0, 0] + emb_out[0, 0]
+        return lambda a, b, k2: f(a, b, k2, G)
+    return build
+
+
+if __name__ == "__main__":
+    for B in (8, 32):
+        s = slope_time(build_blockneg(B))
+        print(f"banded blockneg B={B}: {s*1e3:8.2f} ms/step  "
+              f"{C/s/1e6:6.2f} M centers/s")

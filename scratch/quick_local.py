@@ -1,0 +1,43 @@
+"""Quick TPU measurement of the banded local trainer at bench shapes."""
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402
+bench._enable_compilation_cache()
+
+import numpy as np  # noqa: E402
+
+corpus = tempfile.mkdtemp() + "/corpus.txt"
+t0 = time.time()
+bench.write_corpus(corpus)
+prebuilt = bench._build(corpus)
+print(f"corpus+dict: {time.time()-t0:.1f}s, "
+      f"vocab={prebuilt[0].size}", flush=True)
+
+from multiverso_tpu.models.wordembedding import (  # noqa: E402
+    DeviceCorpusTrainer, Word2Vec, Word2VecConfig)
+
+for neg_block, centers in ((1, 16384), (8, 16384), (32, 16384),
+                           (32, 32768), (8, 8192)):
+    config = Word2VecConfig(embedding_size=bench.DIM, window=5,
+                            negative=bench.NEG, epochs=1,
+                            batch_size=bench.BATCH, sample=1e-3,
+                            neg_block=neg_block)
+    model = Word2Vec(config, prebuilt[0])
+    trainer = DeviceCorpusTrainer(model, prebuilt[1], centers, 16)
+    # warm both layout variants
+    trainer.train_epoch(seed=99, max_steps=32)
+    float(model._emb_in[0, 0])
+    model = Word2Vec(config, prebuilt[0])
+    trainer = DeviceCorpusTrainer(model, prebuilt[1], centers, 16)
+    float(model._emb_in[0, 0])
+    float(trainer._corpus.flat[0])
+    t0 = time.perf_counter()
+    loss, pairs = trainer.train_epoch(seed=0)
+    el = time.perf_counter() - t0
+    print(f"neg_block={neg_block:2d} C={centers:5d}: "
+          f"{model.trained_words/el/1e6:6.2f} M raw words/s  "
+          f"loss/pair={loss/max(pairs,1):.4f}  epoch={el:.1f}s",
+          flush=True)

@@ -1,0 +1,124 @@
+"""Microbenchmark: scatter-add / gather / sweep cost at the bench table
+shape (1M x 128 f32) on the real chip. Timing forces completion with a
+scalar readback (block_until_ready lies on this platform)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+
+R, C = 1_000_000, 128
+TABLE_BYTES = R * C * 4
+
+
+def force(x):
+    return float(jnp.ravel(x)[0])
+
+
+def timeit(fn, *args, n=6):
+    fn(*args)  # compile
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        force(out if not isinstance(out, tuple) else out[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+key = jax.random.PRNGKey(0)
+table = jnp.zeros((R, C), jnp.float32)
+
+results = {}
+
+# Pure sweep: read+write whole table.
+sweep = jax.jit(lambda t: t + 1.0)
+dt = timeit(sweep, table)
+results["sweep_add1"] = (dt, 2 * TABLE_BYTES / dt / 1e9)
+
+# copy (read+write, no donation)
+copyf = jax.jit(lambda t: jnp.copy(t))
+dt = timeit(copyf, table)
+results["copy"] = (dt, 2 * TABLE_BYTES / dt / 1e9)
+
+for k in (1024, 32768, 491520):
+    ids = jax.random.randint(key, (k,), 0, R, jnp.int32)
+    delta = jnp.ones((k, C), jnp.float32)
+    io_bytes = 2 * k * C * 4
+
+    # scatter-add, donated buffer (the hot-path form)
+    scat = jax.jit(lambda t, i, d: t.at[i].add(d), donate_argnums=0)
+    tt = jnp.zeros((R, C), jnp.float32)
+    scat(tt, ids, delta)  # compile w/ donation (consumes tt)
+    times = []
+    for _ in range(5):
+        tt = jnp.zeros((R, C), jnp.float32)
+        force(tt)
+        t0 = time.perf_counter()
+        tt = scat(tt, ids, delta)
+        force(tt)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    results[f"scatter_donated_k{k}"] = (dt, io_bytes / dt / 1e9)
+
+    # scatter-add sorted-unique flags via segment_sum + sorted ids
+    def scat_sorted(t, i, d):
+        si = jnp.sort(i)
+        order = jnp.argsort(i)
+        return t.at[si].add(d[order], indices_are_sorted=True), si
+
+    scat_s = jax.jit(scat_sorted, donate_argnums=0)
+    tt = jnp.zeros((R, C), jnp.float32)
+    scat_s(tt, ids, delta)
+    times = []
+    for _ in range(5):
+        tt = jnp.zeros((R, C), jnp.float32)
+        force(tt)
+        t0 = time.perf_counter()
+        out = scat_s(tt, ids, delta)
+        force(out[0])
+        tt = out[0]
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    results[f"scatter_sorted_k{k}"] = (dt, io_bytes / dt / 1e9)
+
+    # gather
+    gath = jax.jit(lambda t, i: t[i])
+    dt = timeit(gath, table, ids)
+    results[f"gather_k{k}"] = (dt, k * C * 4 / dt / 1e9)
+
+# scan of G=8 scatter-adds inside ONE jit (the group structure):
+# measures whether XLA amortizes anything across steps.
+G = 8
+k = 32768
+ids_g = jax.random.randint(key, (G, k), 0, R, jnp.int32)
+delta_g = jnp.ones((G, k, C), jnp.float32)
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def scan_scatter(t, ids, deltas):
+    def body(t, xs):
+        i, d = xs
+        return t.at[i].add(d), 0.0
+    t, _ = jax.lax.scan(body, t, (ids, deltas))
+    return t
+
+
+tt = jnp.zeros((R, C), jnp.float32)
+scan_scatter(tt, ids_g, delta_g)
+times = []
+for _ in range(4):
+    tt = jnp.zeros((R, C), jnp.float32)
+    force(tt)
+    t0 = time.perf_counter()
+    tt = scan_scatter(tt, ids_g, delta_g)
+    force(tt)
+    times.append(time.perf_counter() - t0)
+dt = min(times)
+results[f"scan{G}_scatter_k{k}"] = (dt / G, 2 * k * C * 4 / (dt / G) / 1e9)
+
+for name, (dt, gbps) in results.items():
+    print(f"{name:28s} {dt*1e3:9.3f} ms  {gbps:8.2f} GB/s(io)")

@@ -1,0 +1,102 @@
+"""Slope-time the real SGNS group step and its pieces at bench shapes.
+
+Pieces: prep-ids (window former + negs), row gathers, loss+grads,
+scatter-adds — each cumulative variant scanned G times inside one jit, so
+the ~100ms readback RTT cancels in the slope.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import sys
+sys.path.insert(0, "/root/repo")
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+
+from multiverso_tpu.models.wordembedding.device_train import (
+    _window_and_negs, _sgns_loss_and_grads, _apply_step)
+
+V, D = 1_013_245, 128
+N = 6_000_000          # corpus tokens
+C, W, K = 32768, 5, 5
+key = jax.random.PRNGKey(0)
+
+kept = jax.random.randint(key, (N,), 0, V, jnp.int32)
+ksent = jnp.repeat(jnp.arange(N // 40, dtype=jnp.int32), 40)[:N]
+neg_prob = jax.random.uniform(key, (V,))
+neg_alias = jax.random.randint(key, (V,), 0, V, jnp.int32)
+n_kept = jnp.int32(N - 1000)
+
+
+def force(x):
+    return float(jnp.ravel(x)[0])
+
+
+def slope_time(build, lo=4, hi=16):
+    def run(G):
+        emb_in = jnp.zeros((V, D), jnp.float32)
+        emb_out = jnp.zeros((V, D), jnp.float32)
+        fn = build(G)
+        out = fn(emb_in, emb_out, jax.random.PRNGKey(1))
+        force(out)
+        best = float("inf")
+        for _ in range(3):
+            emb_in = jnp.zeros((V, D), jnp.float32)
+            emb_out = jnp.zeros((V, D), jnp.float32)
+            force(emb_in); force(emb_out)
+            t0 = time.perf_counter()
+            out = fn(emb_in, emb_out, jax.random.PRNGKey(2))
+            force(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+    t_lo, t_hi = run(lo), run(hi)
+    return (t_hi - t_lo) / (hi - lo)
+
+
+def variant(stage):
+    def build(G):
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnums=3)
+        def f(emb_in, emb_out, key, g):
+            def body(carry, base):
+                emb_in, emb_out, key = carry
+                key, sub = jax.random.split(key)
+                centers, ctx, negs, pmask = _window_and_negs(
+                    C, W, K, N, kept, ksent, neg_prob, neg_alias, sub,
+                    base, n_kept)
+                if stage == "ids":
+                    s = (centers.sum() + ctx.sum() + negs.sum()
+                         + pmask.sum())
+                    return (emb_in, emb_out, key), s.astype(jnp.float32)
+                v = emb_in[centers]
+                u_ctx = emb_out[ctx]
+                u_neg = emb_out[negs]
+                if stage == "gather":
+                    s = v.sum() + u_ctx.sum() + u_neg.sum()
+                    return (emb_in, emb_out, key), s
+                loss, g_v, g_ctx, g_neg = _sgns_loss_and_grads(
+                    v, u_ctx, u_neg, pmask)
+                if stage == "grads":
+                    s = loss + g_v.sum() + g_ctx.sum() + g_neg.sum()
+                    return (emb_in, emb_out, key), s
+                emb_in = emb_in.at[centers].add(-0.01 * g_v)
+                out_ids = jnp.concatenate([ctx, negs], axis=1)
+                g_out = jnp.concatenate([g_ctx, g_neg], axis=1)
+                emb_out = emb_out.at[out_ids].add(-0.01 * g_out)
+                return (emb_in, emb_out, key), loss
+
+            bases = jnp.arange(g, dtype=jnp.int32) * C
+            (emb_in, emb_out, key), outs = jax.lax.scan(
+                body, (emb_in, emb_out, key), bases)
+            return outs.sum() + emb_in[0, 0] + emb_out[0, 0]
+        return lambda a, b, k2: f(a, b, k2, G)
+    return build
+
+
+for stage in ("ids", "gather", "grads", "full"):
+    s = slope_time(variant(stage))
+    words_per_sec = C / s
+    print(f"{stage:8s} {s*1e3:8.2f} ms/step   {words_per_sec/1e6:6.2f} "
+          f"M centers/s")

@@ -323,7 +323,7 @@ class TestMAWord2Vec:
         mesh = meshlib.local_mesh(ndev)
         C, W, K, n_local, V, D, G = 64, 2, 3, 512, 40, 8, 2
         rng = np.random.default_rng(0)
-        fn = _ma_group_fn(mesh, C, W, K, n_local)
+        fn = _ma_group_fn(mesh, C, W, K)
         emb_in = jnp.asarray(
             (rng.random((V, D)).astype(np.float32) - 0.5) / D)
         emb_out = jnp.zeros((V, D), jnp.float32)
