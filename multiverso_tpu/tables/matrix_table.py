@@ -259,16 +259,21 @@ class MatrixWorker(WorkerTable):
         (sorted-unique row sets — possibly tail-padded by repeating the
         last id — satisfy this).
 
-        DEVICE ids (a ``jax.Array``, single-server tables only — host
-        bytes would be needed to partition across servers) pass through
-        the stack without ever touching the host: any shape, any order,
-        duplicates welcome — the reply is the XLA gather
-        ``table[row_ids]`` with shape ``row_ids.shape + (num_col,)``.
-        This is the key enabler for trainers whose row sets are computed
-        on device (models/wordembedding/device_train.py PS mode)."""
+        DEVICE ids (a ``jax.Array``) pass through the stack without ever
+        touching the host: any shape, any order, duplicates welcome —
+        the reply is the XLA gather ``table[row_ids]`` with shape
+        ``row_ids.shape + (num_col,)``. This is the key enabler for
+        trainers whose row sets are computed on device
+        (models/wordembedding/device_train.py PS mode).
+
+        Multi-server: splitting device ids into per-server subsets
+        would need data-dependent shapes (a host sync), so instead the
+        SAME id blob goes to every server; each gathers only its own
+        rows (foreign rows fill 0) and the worker SUMS the replies —
+        every row is owned by exactly one server, so the sum
+        reassembles the exact gather. Costs one extra [k, C] pass per
+        additional server, all in HBM."""
         if is_device_array(row_ids):
-            CHECK(self._num_server == 1,
-                  "device-key row gets need a single server")
             CHECK(self._zoo.net.in_process,
                   "device-key row gets need in-process servers (a "
                   "serializing transport flattens the keys to host "
@@ -276,6 +281,7 @@ class MatrixWorker(WorkerTable):
             CHECK(not self._compress, "device gets bypass wire compression")
             self._dest, self._dest_rows = None, None
             self._device_shards = {}
+            self._device_sum = self._num_server > 1
             return self._request_get(Blob(row_ids))
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
         CHECK(row_ids.size > 0, "empty device row get")
@@ -286,11 +292,16 @@ class MatrixWorker(WorkerTable):
                   "device row gets need sorted row ids")
         self._dest, self._dest_rows = None, None
         self._device_shards = {}
+        self._device_sum = False  # host-key replies CONCATENATE (a
+        # stale True from an errored device-key get must not survive)
         return self._request_get(Blob(row_ids.view(np.uint8)))
 
     def take_device_rows(self):
         """Assembled result of the last ``get_rows_device_async`` (call
-        after ``wait``); clears the reply slot."""
+        after ``wait``); clears the reply slot. Device-key multi-server
+        replies SUM (each server zero-fills foreign rows); host-key
+        multi-server replies concatenate (each server returned its
+        contiguous sorted segment)."""
         shards = self._device_shards
         CHECK(shards is not None and len(shards) > 0,
               "no device row get outstanding")
@@ -299,6 +310,9 @@ class MatrixWorker(WorkerTable):
         if len(ordered) == 1:
             return ordered[0]
         import jax.numpy as jnp
+        if getattr(self, "_device_sum", False):
+            self._device_sum = False
+            return functools.reduce(jnp.add, ordered)
         return jnp.concatenate(ordered, axis=0)
 
     def _request_get(self, keys: Blob) -> int:
@@ -338,8 +352,10 @@ class MatrixWorker(WorkerTable):
         SUM only under stateless updaters (default/sgd) — the engine
         rejects stateful rules on this path."""
         if is_device_array(row_ids):
-            CHECK(self._num_server == 1,
-                  "device-key row adds need a single server")
+            # Multi-server: the same ids+delta blobs go to every server;
+            # each scatter-adds only its own rows (foreign rows masked
+            # out-of-range and dropped), so the union applies the full
+            # delta exactly once.
             CHECK(self._zoo.net.in_process,
                   "device-key row adds need in-process servers")
             CHECK(self._updater_stateless,
@@ -403,10 +419,11 @@ class MatrixWorker(WorkerTable):
     # -- partition (ref: matrix_table.cpp:234-315) --
     def partition(self, blobs, msg_type) -> Dict[int, List[Blob]]:
         if blobs[0].on_device:
-            # Device-key requests: single server by construction (the
-            # async entry points CHECK it), so the whole request passes
-            # through without a host round-trip for the id vector.
-            return {0: list(blobs)}
+            # Device-key requests: the same blob list goes to EVERY
+            # server (object references — zero copies in-process); each
+            # server masks foreign rows on device. Splitting the ids
+            # here would need their values on the host.
+            return {sid: list(blobs) for sid in range(self._num_server)}
         keys = blobs[0].as_array(np.int32)
         out: Dict[int, List[Blob]] = {}
         if keys.size == 1 and keys[0] < 0:
@@ -506,6 +523,7 @@ class MatrixWorker(WorkerTable):
               "device dirty gets need an in-process single server")
         self._dest, self._dest_rows = None, None
         self._device_shards = {}
+        self._device_sum = False
         self._device_shard_ids = {}
         self.wait(self._request_get(
             Blob(_ALL_KEY_DEVICE_REPLY.view(np.uint8))))
@@ -519,17 +537,21 @@ class MatrixWorker(WorkerTable):
         CHECK(not self.is_sparse,
               "device get is for dense tables (sparse replies are ragged)")
         self._dest, self._dest_rows, self._device_shards = None, None, {}
+        self._device_sum = False
         self.wait(self._request_get(Blob(_ALL_KEY.view(np.uint8))))
         return self.take_device_rows()
 
     # -- replies (ref: matrix_table.cpp:317-341) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
         if reply_blobs[0].on_device:
-            # Device-key reply (single server): values arrive shaped
-            # row_ids.shape + (num_col,), still in HBM.
+            # Device-key reply: values arrive shaped
+            # row_ids.shape + (num_col,), still in HBM. Multi-server
+            # replies all carry the SAME (shared) id blob, so key by
+            # arrival order — take_device_rows sums them.
             CHECK(self._device_shards is not None,
                   "device reply with no device get outstanding")
-            self._device_shards[0] = reply_blobs[1].typed(self.dtype)
+            self._device_shards[len(self._device_shards)] = \
+                reply_blobs[1].typed(self.dtype)
             return
         keys = reply_blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
@@ -641,16 +663,17 @@ class MatrixServer(ServerTable):
         if blobs[0].on_device:
             # Device-key scatter-add: ids and delta never touch the
             # host. Dense tables only (sparse staleness bookkeeping
-            # needs host ids).
+            # needs host ids). Multi-server: every server receives the
+            # full request; foreign rows are masked out-of-range here
+            # and dropped by the scatter.
             CHECK(self._up_to_date is None,
                   "device-key adds are for dense tables")
             option = AddOption.from_blob(blobs[2]) \
                 if len(blobs) == 3 else None
-            rows = blobs[0].typed(np.int32)
-            if self.row_offset:
-                rows = rows - self.row_offset
             self._data = self._engine.apply_rows(
-                self._data, rows, blobs[1].typed(self.dtype), option)
+                self._data, blobs[0].typed(np.int32),
+                blobs[1].typed(self.dtype), option,
+                bounds=self._shard_bounds)
             return
         keys = blobs[0].as_array(np.int32)
         if self._compress:
@@ -714,14 +737,16 @@ class MatrixServer(ServerTable):
     def process_get(self, blobs: List[Blob]) -> List[Blob]:
         if blobs[0].on_device:
             # Dense device-key gather: reply values shaped
-            # ids.shape + (C,), all in HBM.
+            # ids.shape + (C,), all in HBM. Multi-server: foreign rows
+            # mask out-of-range and gather as 0 — the worker sums the
+            # per-server replies (each row owned by exactly one server).
             CHECK(self._up_to_date is None,
                   "device-key gets are for dense tables (sparse dirty "
                   "gets use the -2 host sentinel)")
             rows = blobs[0].typed(np.int32)
-            if self.row_offset:
-                rows = rows - self.row_offset
-            return [blobs[0], Blob(self._gather(self._data, rows))]
+            gather = self._gather if self._shard_bounds is None \
+                else self._gather_bounded
+            return [blobs[0], Blob(gather(self._data, rows))]
         keys = blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -2:
             CHECK(self._up_to_date is not None and len(blobs) >= 2,
@@ -776,6 +801,35 @@ class MatrixServer(ServerTable):
     def _gather(self):
         return jax.jit(lambda data, rows: data.at[rows].get(
             mode="fill", fill_value=0))
+
+    @property
+    def _shard_bounds(self):
+        """(row_offset, my_rows) when global row ids need masking to
+        this shard — multi-server only. A single server owns every row,
+        and the extra in-jit compare/offset would cost nothing, but a
+        SEPARATE program variant would recompile the engine's scatter;
+        None keeps the round-3 single-server program byte-identical."""
+        if self._zoo.num_servers > 1:
+            return (self.row_offset, self.my_rows)
+        return None
+
+    @functools.cached_property
+    def _gather_bounded(self):
+        """Masked gather in ONE jitted program (multi-server device
+        keys): global ids -> local indices, foreign rows -> the padded
+        row count, which gather-fills 0. NOTE: simply subtracting the
+        offset is NOT enough — a foreign row could land inside this
+        shard's padding and read whatever a scatter left there."""
+        ofs, n = self.row_offset, self.my_rows
+        padded = self._data.shape[0]
+        import jax.numpy as jnp
+
+        def gather(data, rows):
+            local = jnp.where((rows >= ofs) & (rows < ofs + n),
+                              rows - ofs, padded)
+            return data.at[local].get(mode="fill", fill_value=0)
+
+        return jax.jit(gather)
 
     def _values(self):
         """Fresh-buffer snapshot of the logical rows (see ArrayServer._values

@@ -62,6 +62,7 @@ class UpdateEngine:
 
         self._dense = jax.jit(dense_padded, donate_argnums=(0, 1))
         self._rows = jax.jit(self.rule.rows, donate_argnums=(0, 1))
+        self._rows_bounded = {}
 
     def apply_dense(self, data, delta, option: Optional[AddOption] = None):
         hyp, worker_id = _unpack(option)
@@ -70,12 +71,15 @@ class UpdateEngine:
         return data
 
     def apply_rows(self, data, row_ids, delta,
-                   option: Optional[AddOption] = None):
+                   option: Optional[AddOption] = None, bounds=None):
         """``row_ids`` int32[k], ``delta`` [k, ...]; pads to a power-of-two
         bucket with out-of-range indices (dropped by scatter). Device
         row_ids (any shape, delta shaped ids.shape + row shape) skip
         padding — the caller's shapes are already fixed, so each distinct
-        caller shape compiles exactly once."""
+        caller shape compiles exactly once. ``bounds=(offset, n)`` maps
+        GLOBAL row ids to this shard's local indices INSIDE the jit
+        (foreign rows go out-of-range and drop) — one dispatch, not a
+        separate masking op per request."""
         hyp, worker_id = _unpack(option)
         from ..core.blob import is_device_array
         if is_device_array(row_ids):
@@ -89,9 +93,32 @@ class UpdateEngine:
                   "(default/sgd): duplicate ids must sum")
         else:
             row_ids, delta = pad_rows(row_ids, delta, self.shape[0])
-        data, self._state = self._rows(data, self._state, row_ids, delta,
-                                       hyp, worker_id)
+        rows_fn = self._rows if bounds is None \
+            else self._bounded_rows_fn(bounds)
+        data, self._state = rows_fn(data, self._state, row_ids, delta,
+                                    hyp, worker_id)
         return data
+
+    def _bounded_rows_fn(self, bounds):
+        fn = self._rows_bounded.get(bounds)
+        if fn is None:
+            import jax.numpy as jnp
+            ofs, n = bounds
+            padded = self.shape[0]
+            rule_rows = self.rule.rows
+
+            def rows_fn(data, st, row_ids, delta, hyp, worker_id):
+                # Foreign rows map to the padded row count: out of range
+                # for the scatter (drop) — NOT merely offset-shifted,
+                # which could land a foreign row inside this shard's
+                # padding where a later masked gather would read it.
+                row_ids = jnp.where((row_ids >= ofs) & (row_ids < ofs + n),
+                                    row_ids - ofs, padded)
+                return rule_rows(data, st, row_ids, delta, hyp, worker_id)
+
+            fn = jax.jit(rows_fn, donate_argnums=(0, 1))
+            self._rows_bounded[bounds] = fn
+        return fn
 
     @property
     def state(self):

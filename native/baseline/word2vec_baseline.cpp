@@ -181,6 +181,23 @@ int main(int argc, char** argv) {
     return exp_table[static_cast<int>((x / kMaxExp + 1.0f) *
                                       (kExpTableSize / 2))];
   };
+  // Per-epoch average pair loss (one positive + `negative` xent terms
+  // per pair, matching the TPU trainer's accounting) — the convergence
+  // record the reference's apps log
+  // (ref: Applications/LogisticRegression/src/logreg.cpp:41-87).
+  // Table-lookup log-sigmoid keeps the cost out of the hot loop.
+  std::vector<float> logsig_table(kExpTableSize);
+  for (int i = 0; i < kExpTableSize; ++i)
+    logsig_table[i] = std::log(std::max(exp_table[i], 1e-9f));
+  auto xent = [&](float dot, float label) -> float {
+    float z = label > 0.5f ? dot : -dot;  // log sigmoid(z)
+    if (z >= kMaxExp) return 0.0f;
+    if (z <= -kMaxExp) return -logsig_table[0];
+    return -logsig_table[static_cast<int>((z / kMaxExp + 1.0f) *
+                                          (kExpTableSize / 2))];
+  };
+  std::vector<double> epoch_losses;
+  std::vector<long long> epoch_pairs;
 
   // ---- embeddings ----
   std::vector<float> emb_in(static_cast<size_t>(V) * dim);
@@ -195,7 +212,9 @@ int main(int argc, char** argv) {
   int64_t words_done = 0;
   auto start = std::chrono::steady_clock::now();
   for (int epoch = 0; epoch < epochs; ++epoch) {
-#pragma omp parallel
+    double loss_sum = 0.0;
+    long long pair_count = 0;
+#pragma omp parallel reduction(+ : loss_sum, pair_count)
     {
       std::vector<int32_t> kept;
       std::vector<float> grad_v(dim);
@@ -242,14 +261,20 @@ int main(int argc, char** argv) {
               float dot = 0.0f;
               for (int i = 0; i < dim; ++i) dot += v[i] * u[i];
               const float g = (label - sigmoid(dot)) * lr;
+              loss_sum += xent(dot, label);
               for (int i = 0; i < dim; ++i) grad_v[i] += g * u[i];
               for (int i = 0; i < dim; ++i) u[i] += g * v[i];
             }
+            pair_count += 1;
             for (int i = 0; i < dim; ++i) v[i] += grad_v[i];
           }
         }
       }
     }
+    epoch_losses.push_back(loss_sum / std::max(pair_count, 1LL));
+    epoch_pairs.push_back(pair_count);
+    std::fprintf(stderr, "epoch %d: avg pair loss %.4f (%lld pairs)\n",
+                 epoch, epoch_losses.back(), pair_count);
   }
   auto elapsed = std::chrono::duration<double>(
                      std::chrono::steady_clock::now() - start)
@@ -277,10 +302,20 @@ int main(int argc, char** argv) {
     }
   }
 
+  std::string losses_json = "[";
+  for (size_t i = 0; i < epoch_losses.size(); ++i) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%s%.4f", i ? ", " : "",
+                  epoch_losses[i]);
+    losses_json += buf;
+  }
+  losses_json += "]";
   std::printf(
       "{\"words_per_sec\": %.0f, \"elapsed_sec\": %.2f, \"epochs\": %d, "
-      "\"vocab\": %d, \"tokens\": %lld, \"threads\": %d}\n",
+      "\"vocab\": %d, \"tokens\": %lld, \"threads\": %d, "
+      "\"epoch_losses\": %s}\n",
       total_words / elapsed, elapsed, epochs, V,
-      static_cast<long long>(n_tokens), omp_get_max_threads());
+      static_cast<long long>(n_tokens), omp_get_max_threads(),
+      losses_json.c_str());
   return 0;
 }

@@ -423,18 +423,33 @@ class TestDeviceResidentPath:
         np.testing.assert_array_equal(table.get(),
                                       np.ones((16, 4), np.float32))
 
-    def test_matrix_device_keys_rejected_multi_server(self):
+    def test_matrix_device_keys_multi_server_roundtrip(self):
+        # Device keys broadcast to every server; each masks foreign
+        # rows (gather fills 0, scatter drops) and the worker SUMS the
+        # replies — exact gather/scatter semantics across 2 servers,
+        # duplicates included, without the ids ever touching the host.
         def body(rank):
             import jax.numpy as jnp
             table = mv.create_matrix_table(10, 3)
-            err = None
-            try:
-                table.get_rows_device(jnp.asarray(
-                    np.array([1, 2], np.int32)))
-            except Exception as exc:  # noqa: BLE001
-                err = "single server" in str(exc)
+            base = np.arange(30, dtype=np.float32).reshape(10, 3)
+            if rank == 0:
+                table.add(base)
             mv.current_zoo().barrier()
-            return err
+            # ids span both servers' row ranges (0-4 / 5-9), unsorted,
+            # with a duplicate
+            ids = jnp.asarray(np.array([[7, 1], [1, 9]], np.int32))
+            got = np.asarray(table.get_rows_device(ids))
+            ok_get = np.array_equal(got, base[np.asarray(ids)])
+            if rank == 0:
+                table.add_rows(ids, jnp.ones((2, 2, 3), jnp.float32))
+            mv.current_zoo().barrier()
+            after = table.get_rows(np.array([7, 1, 9, 0], np.int32))
+            ok_add = (np.array_equal(after[0], base[7] + 1)
+                      and np.array_equal(after[1], base[1] + 2)  # dup
+                      and np.array_equal(after[2], base[9] + 1)
+                      and np.array_equal(after[3], base[0]))
+            mv.current_zoo().barrier()
+            return ok_get and ok_add
 
         assert all(LocalCluster(2).run(body))
 
