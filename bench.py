@@ -219,11 +219,9 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
     tests/test_net_integration.py, multi-chip sharding by
     __graft_entry__.dryrun_multichip."""
     import multiverso_tpu as mv
-    from multiverso_tpu.models.wordembedding import (BlockLoader,
-                                                     PSDeviceCorpusTrainer,
+    from multiverso_tpu.models.wordembedding import (PSDeviceCorpusTrainer,
                                                      PSWord2Vec,
-                                                     Word2VecConfig,
-                                                     iter_pair_batches)
+                                                     Word2VecConfig)
     dictionary, tokenized = prebuilt if prebuilt else _build(corpus)
     mv.init([])
     config = Word2VecConfig(embedding_size=DIM, window=5, negative=NEG,
@@ -258,27 +256,6 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
     words = model.trained_words - warm_words
     median_wps = hook.median_wps()
 
-    # Host-batch PS segment (row-set prep on the host, the path that
-    # also runs cross-process over TCP): a short pipelined stretch.
-    def capped(seed, cap):
-        for i, batch in enumerate(iter_pair_batches(
-                dictionary, tokenized, batch_size=BATCH, window=5,
-                subsample=1e-3, seed=seed)):
-            if i >= cap:
-                return
-            yield batch
-
-    for warm_batch in capped(99, 3):
-        model.train_batch(warm_batch)
-    # Bring the loader/actor/device pipeline to steady state before
-    # timing — words/s is a rate, and a cold pipeline understates it.
-    model.train_batches(BlockLoader(model.prepared(capped(98, 10))))
-    hb_words_0 = model.trained_words
-    hb_start = time.perf_counter()
-    model.train_batches(BlockLoader(model.prepared(
-        capped(0, PS_MAX_BATCHES))))
-    hb_elapsed = time.perf_counter() - hb_start
-    hostbatch_wps = (model.trained_words - hb_words_0) / hb_elapsed
     # Observability artifacts for the overhead hunt: the Dashboard
     # counter report (stderr) and an xprof trace of a few PS blocks
     # (ref: the reference ends its perf harness with Dashboard::Display,
@@ -306,9 +283,54 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
                 (words + warm_words) / (warm_secs + elapsed), 0),
             "warmup_seconds": round(warm_secs, 1),
             "median_batch_wps": round(float(median_wps), 0),
-            "hostbatch_wps": round(hostbatch_wps, 0),
             "avg_loss": round(loss_sum / max(pairs, 1), 4),
             "separation": round(float(separation), 4)}
+
+
+HOSTBATCH_SIZE = 131072  # the host-batch path is upload/dispatch bound
+#   per BLOCK, so the cross-process-capable segment uses reference-style
+#   big data blocks (the reference's loader also ships multi-sentence
+#   blocks, ref: distributed_wordembedding.cpp:33-56)
+
+
+def run_hostbatch(prebuilt) -> dict:
+    """The HOST-BATCH PS path (row sets prepped host-side — the form
+    that also runs cross-process over TCP), timed as its own phase with
+    reference-style large blocks."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding import (BlockLoader,
+                                                     PSWord2Vec,
+                                                     Word2VecConfig,
+                                                     iter_pair_batches)
+    dictionary, tokenized = prebuilt
+    mv.init([])
+    config = Word2VecConfig(embedding_size=DIM, window=5, negative=NEG,
+                            epochs=EPOCHS, batch_size=HOSTBATCH_SIZE,
+                            sample=1e-3, use_ps=True,
+                            neg_block=NEG_BLOCK)
+    model = PSWord2Vec(config, dictionary)
+
+    def capped(seed, cap):
+        for i, batch in enumerate(iter_pair_batches(
+                dictionary, tokenized, batch_size=HOSTBATCH_SIZE,
+                window=5, subsample=1e-3, seed=seed)):
+            if i >= cap:
+                return
+            yield batch
+
+    for warm_batch in capped(99, 3):
+        model.train_batch(warm_batch)
+    # Bring the loader/actor/device pipeline to steady state before
+    # timing — words/s is a rate, and a cold pipeline understates it.
+    model.train_batches(BlockLoader(model.prepared(capped(98, 6))))
+    words_0 = model.trained_words
+    start = time.perf_counter()
+    model.train_batches(BlockLoader(model.prepared(capped(0, 120))))
+    model._drain_pushes()
+    elapsed = time.perf_counter() - start
+    mv.shutdown()
+    return {"wps": round((model.trained_words - words_0) / elapsed, 0),
+            "batch_size": HOSTBATCH_SIZE}
 
 
 def run_quality(prebuilt, cpp_sep: float, use_ps: bool) -> dict:
@@ -330,42 +352,36 @@ def run_quality(prebuilt, cpp_sep: float, use_ps: bool) -> dict:
     config = Word2VecConfig(embedding_size=DIM, window=5, negative=NEG,
                             epochs=QUALITY_EPOCHS, sample=1e-3,
                             per_pair=True, use_ps=use_ps)
-    if use_ps:
-        mv.init([])
-        model = PSWord2Vec(config, dictionary)
-        trainer = PSDeviceCorpusTrainer(model, tokenized, QUALITY_C)
 
-        def fetch(ids):
-            model._drain_pushes()
-            return model._in_table.get_rows(ids)
-    else:
-        model = Word2Vec(config, dictionary)
-        trainer = DeviceCorpusTrainer(model, tokenized, QUALITY_C,
-                                      QUALITY_DISPATCH)
+    def setup():
+        """(model, trainer, fetch) — one shared construction for the
+        warm pass and the timed pass, so they cannot drift apart."""
+        if use_ps:
+            mv.init([])
+            model = PSWord2Vec(config, dictionary)
+            trainer = PSDeviceCorpusTrainer(model, tokenized, QUALITY_C)
 
-        def fetch(ids):
-            return np.asarray(model._emb_in[jnp.asarray(ids)])
+            def fetch(ids):
+                model._drain_pushes()
+                return model._in_table.get_rows(ids)
+        else:
+            model = Word2Vec(config, dictionary)
+            trainer = DeviceCorpusTrainer(model, tokenized, QUALITY_C,
+                                          QUALITY_DISPATCH)
+
+            def fetch(ids):
+                return np.asarray(model._emb_in[jnp.asarray(ids)])
+
+        return model, trainer, fetch
 
     # Warm the compile set out of the timed region (cached across runs).
+    model, trainer, fetch = setup()
     trainer.train_epoch(seed=99, max_steps=2 * QUALITY_DISPATCH)
     fetch(np.array([0], np.int32))
     if use_ps:
         mv.shutdown()
-        mv.init([])
-        model = PSWord2Vec(config, dictionary)
-        trainer = PSDeviceCorpusTrainer(model, tokenized, QUALITY_C)
-
-        def fetch(ids):  # noqa: F811 - rebound to the fresh model
-            model._drain_pushes()
-            return model._in_table.get_rows(ids)
-    else:
-        model = Word2Vec(config, dictionary)
-        trainer = DeviceCorpusTrainer(model, tokenized, QUALITY_C,
-                                      QUALITY_DISPATCH)
-
-        def fetch(ids):  # noqa: F811
-            return np.asarray(model._emb_in[jnp.asarray(ids)])
-
+    model, trainer, fetch = setup()
+    if not use_ps:
         float(model._emb_in[0, 0])
 
     start = time.perf_counter()
@@ -418,7 +434,9 @@ def run_ps_two_workers(prebuilt, blocks: int = 80) -> dict:
         elapsed = time.perf_counter() - t0
         return model.trained_words - w0, elapsed
 
-    results = LocalCluster(2, roles=["all", "worker"]).run(body)
+    cluster = LocalCluster(2, roles=["all", "worker"])
+    cluster.timeout = 600.0  # 2 ranks time-share one dispatch path
+    results = cluster.run(body)
     words = sum(r[0] for r in results)
     elapsed = max(r[1] for r in results)
     return {"aggregate_wps": round(words / elapsed, 0),
@@ -454,7 +472,9 @@ def run_ps_two_servers(prebuilt, blocks: int = 80) -> dict:
         trainer.train_epoch(seed=0, max_steps=blocks)
         return model.trained_words - w0, time.perf_counter() - t0
 
-    results = LocalCluster(2, roles=["all", "server"]).run(body)
+    cluster = LocalCluster(2, roles=["all", "server"])
+    cluster.timeout = 600.0
+    results = cluster.run(body)
     words, elapsed = results[0]
     return {"wps": round(words / elapsed, 0)}
 
@@ -733,6 +753,15 @@ def matrix_bandwidth() -> dict:
         float(s0)  # force EACH call: the async pipeline would
         # otherwise hide the per-call roundtrip
     dispatch_ms = (time.perf_counter() - t0) / 20 * 1e3
+    # Per-PROGRAM launch floor: chained (no readback) executions still
+    # serialize device-side at ~3-15ms each on the tunneled platform —
+    # the hard floor under any eager add/get alternation (e.g. the
+    # sparse dirty roundtrip = 2-3 programs per iteration).
+    t0 = time.perf_counter()
+    for _ in range(40):
+        s0 = tiny(s0)
+    float(s0)
+    launch_ms = (time.perf_counter() - t0) / 40 * 1e3
 
     # Sparse dirty-row path (ref: test_matrix_perf.cpp sparse variants):
     # dirty rows per round, dirty-only whole-table get — measured on
@@ -835,7 +864,8 @@ def matrix_bandwidth() -> dict:
             "sparse_dirty_hostbuf_gbps": round(host_sparse_gbps, 3),
             "tunnel_upload_mbps": round(up_mbps, 1),
             "tunnel_download_mbps": round(down_mbps, 1),
-            "dispatch_roundtrip_ms": round(dispatch_ms, 3)}
+            "dispatch_roundtrip_ms": round(dispatch_ms, 3),
+            "program_launch_ms": round(launch_ms, 3)}
 
 
 def _phase(name: str, fn, *args, **kw):
@@ -882,6 +912,10 @@ def main() -> None:
     cpp_sep = cpp.get("topic_separation", CPP_SEP_FALLBACK)
     local = _phase("local_train", run_local, corpus, prebuilt)
     ps = _phase("ps_train", run_ps, corpus, prebuilt)
+    try:
+        hostbatch = _phase("ps_hostbatch", run_hostbatch, prebuilt)
+    except Exception as exc:  # noqa: BLE001
+        hostbatch = {"error": str(exc)[:200]}
     try:
         quality_local = _phase("quality_local", run_quality, prebuilt,
                                cpp_sep, False)
@@ -951,7 +985,8 @@ def main() -> None:
             "ps_cold_words_per_sec": ps["cold_wps"],
             "ps_warmup_seconds": ps["warmup_seconds"],
             "ps_median_batch_words_per_sec": ps["median_batch_wps"],
-            "ps_hostbatch_words_per_sec": ps["hostbatch_wps"],
+            "ps_hostbatch_words_per_sec": hostbatch.get("wps"),
+            "ps_hostbatch_batch_size": hostbatch.get("batch_size"),
             "ps_vs_local": round(ps["wps"] / local["wps"], 3),
             "ps_avg_loss": ps["avg_loss"],
             "ps_topic_separation": ps["separation"],

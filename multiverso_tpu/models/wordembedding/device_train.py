@@ -119,6 +119,14 @@ def _draw_negs(C, K, B, neg_prob, neg_alias, k_idx, k_keep):
     return jnp.where(keep_draw, draw, neg_alias[draw])
 
 
+def _hs_center_cap(path_len: int, dim: int) -> int:
+    """Centers-per-step bound for the HS pipelines: the banded path
+    activations are [C+2W, L, D] plus their grad — cap C so they stay
+    within ~1.5 GB of HBM. Shared by the local and PS trainers so the
+    budget cannot drift between them."""
+    return max((3 << 29) // (3 * max(path_len, 1) * dim * 4), 64)
+
+
 def _banded_sgns_loss_and_grads(v, u_band, u_neg, pmask):
     """SGNS objective in banded form: context logits are dot products
     of each center row against 2W shifted slices of the band's OUTPUT
@@ -536,10 +544,8 @@ class DeviceCorpusTrainer:
             # pass a smaller centers_per_step, larger is refused by the
             # cap rather than by an HBM OOM mid-epoch.
             path_len = max(int(model._points_host.shape[1]), 1)
-            dim = int(config.embedding_size)
-            budget = 3 << 29  # bytes for path rows + grad
-            cap = max(budget // (3 * path_len * dim * 4), 64)
-            self._C = min(self._C, cap)
+            self._C = min(self._C, _hs_center_cap(
+                path_len, int(config.embedding_size)))
             self._group = _group_fn_hs(self._C, config.window,
                                        bool(config.cbow))
             # aux slots: the Huffman path/code tables.
@@ -604,6 +610,14 @@ class DeviceCorpusTrainer:
                 0.0 if pair_acc is None else float(pair_acc))
 
 
+def _sum_parts(x):
+    """Sum a tuple of per-server reply shards (or pass a single array
+    through) — used INSIDE the PS step jits."""
+    if isinstance(x, (tuple, list)):
+        return functools.reduce(jnp.add, x)
+    return x
+
+
 @functools.lru_cache(maxsize=None)
 def _block_ids_fn_hs(C: int, W: int, cbow: bool = False):
     """HS block preparation for the PS pipeline: the OUTPUT ids are the
@@ -636,6 +650,8 @@ def _block_step_fn_hs(C: int, W: int, L: int, cbow: bool = False):
     ``_block_ids_fn_hs``)."""
 
     def step(v, u, aux, lr, inv_workers):
+        v = _sum_parts(v)
+        u = _sum_parts(u)
         pmask, path, code = aux
         lr_scaled = lr * inv_workers
         if cbow:
@@ -706,6 +722,11 @@ def _block_step_fn(C: int, W: int, K: int, cbow: bool = False,
     nb = C // neg_block
 
     def step(v, u, pmask, lr, inv_workers):
+        # Multi-server pulls arrive as per-server shard tuples (foreign
+        # rows zero-filled); summing them HERE folds the reassembly into
+        # this program instead of a separate eager dispatch per pull.
+        v = _sum_parts(v)
+        u = _sum_parts(u)
         if per_pair:
             u_band0 = u[:C + 2 * W]
             u_negs0 = u[C + 2 * W:].reshape(2 * W, C, K, -1)
@@ -787,9 +808,8 @@ class PSDeviceCorpusTrainer:
                 model._points_dev = jnp.asarray(model._points_host)
                 model._codes_dev = jnp.asarray(model._codes_host)
             path_len = max(int(model._points_host.shape[1]), 1)
-            dim = int(config.embedding_size)
-            cap = max((3 << 29) // (3 * path_len * dim * 4), 64)
-            self._C = min(self._C, cap)
+            self._C = min(self._C, _hs_center_cap(
+                path_len, int(config.embedding_size)))
             self._ids = _block_ids_fn_hs(self._C, config.window,
                                          bool(config.cbow))
             self._step = _block_step_fn_hs(self._C, config.window,
@@ -858,8 +878,10 @@ class PSDeviceCorpusTrainer:
             mid_out = out_table.get_rows_device_async(out_ids)
             in_table.wait(mid_in)
             out_table.wait(mid_out)
-            v = in_table.take_device_rows()
-            u = out_table.take_device_rows()
+            # Per-server shard tuples; the step jit sums them (fused —
+            # no separate reassembly dispatch on multi-server tables).
+            v = tuple(in_table.take_device_row_parts())
+            u = tuple(out_table.take_device_row_parts())
             d_v, d_u, loss, pairs = self._step(
                 v, u, pmask, jnp.float32(model.learning_rate()),
                 jnp.float32(1.0 / model._num_workers))

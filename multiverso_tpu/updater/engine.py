@@ -50,18 +50,46 @@ class UpdateEngine:
         self._state = state
 
         # Table storage is padded to the mesh shard count (uneven shardings
-        # are not device_put-able); deltas arrive logical-sized and are
-        # zero-extended *inside* the jit so XLA fuses the pad into the
-        # update — no host-side copy.
+        # are not device_put-able) and possibly to the 128-lane tile width
+        # in the last dim (sub-lane rows scatter ~25x slower on v5e);
+        # deltas arrive logical-sized and are zero-extended *inside* the
+        # jit so XLA fuses the pad into the update — no host-side copy.
+        def pad_cols(data, delta):
+            """Zero-extend the delta's LAST dim to the storage width."""
+            if delta.ndim >= 2 and data.shape[-1] != delta.shape[-1]:
+                pad = [(0, 0)] * (delta.ndim - 1) \
+                    + [(0, data.shape[-1] - delta.shape[-1])]
+                delta = jax.numpy.pad(delta, pad)
+            return delta
+
         def dense_padded(data, st, delta, hyp, worker_id):
+            delta = pad_cols(data, delta)
             if data.shape[0] != delta.shape[0]:
                 pad = ((0, data.shape[0] - delta.shape[0]),) \
                     + ((0, 0),) * (delta.ndim - 1)
                 delta = jax.numpy.pad(delta, pad)
             return self.rule.dense(data, st, delta, hyp, worker_id)
 
+        def pad_row_count(row_ids, delta):
+            """Zero-extend a [k, ...] delta to the padded id count —
+            in-jit, so a device delta costs no separate pad program
+            (each standalone program execution costs ~10-15ms on the
+            tunneled platform regardless of size)."""
+            if delta.ndim >= 2 and row_ids.ndim == 1 \
+                    and delta.shape[0] != row_ids.shape[0]:
+                pad = ((0, row_ids.shape[0] - delta.shape[0]),) \
+                    + ((0, 0),) * (delta.ndim - 1)
+                delta = jax.numpy.pad(delta, pad)
+            return delta
+
+        def rows_padded(data, st, row_ids, delta, hyp, worker_id):
+            delta = pad_row_count(row_ids, pad_cols(data, delta))
+            return self.rule.rows(data, st, row_ids, delta, hyp,
+                                  worker_id)
+
+        self._pad_cols = pad_cols
         self._dense = jax.jit(dense_padded, donate_argnums=(0, 1))
-        self._rows = jax.jit(self.rule.rows, donate_argnums=(0, 1))
+        self._rows = jax.jit(rows_padded, donate_argnums=(0, 1))
         self._rows_bounded = {}
 
     def apply_dense(self, data, delta, option: Optional[AddOption] = None):
@@ -114,7 +142,9 @@ class UpdateEngine:
                 # padding where a later masked gather would read it.
                 row_ids = jnp.where((row_ids >= ofs) & (row_ids < ofs + n),
                                     row_ids - ofs, padded)
-                return rule_rows(data, st, row_ids, delta, hyp, worker_id)
+                return rule_rows(data, st, row_ids,
+                                 self._pad_cols(data, delta), hyp,
+                                 worker_id)
 
             fn = jax.jit(rows_fn, donate_argnums=(0, 1))
             self._rows_bounded[bounds] = fn
@@ -145,21 +175,19 @@ def pad_ids(row_ids, num_rows: int) -> np.ndarray:
 
 def pad_rows(row_ids, delta, num_rows: int):
     """Pad (row_ids, delta) to the next bucket size; padding rows index
-    out-of-range so scatter drops them and gather fills zeros. Device
-    deltas pad on device (already-bucketed sizes pass through untouched —
-    the zero-copy hot path)."""
+    out-of-range so scatter drops them and gather fills zeros. DEVICE
+    deltas pass through logical-sized — the engine's rows jit extends
+    them to the id count internally (a separate device pad would cost a
+    full program launch per add)."""
     row_ids = np.asarray(row_ids, dtype=np.int32)
     k = row_ids.shape[0]
     b = bucket_size(k)
     if b != k:
         row_ids = np.concatenate(
             [row_ids, np.full(b - k, num_rows, dtype=np.int32)])
-        pad = ((0, b - k),) + ((0, 0),) * (len(np.shape(delta)) - 1)
         from ..core.blob import is_device_array
-        if is_device_array(delta):
-            import jax.numpy as jnp
-            delta = jnp.pad(delta, pad)
-        else:
+        if not is_device_array(delta):
+            pad = ((0, b - k),) + ((0, 0),) * (len(np.shape(delta)) - 1)
             delta = np.pad(np.asarray(delta), pad)
     return row_ids, delta
 

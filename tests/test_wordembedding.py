@@ -529,10 +529,10 @@ class TestPSDevicePipeline:
                                     epochs=3, init_learning_rate=0.01,
                                     batch_size=1024, sample=0)
             model = PSWord2Vec(config, d)
+            trainer = PSDeviceCorpusTrainer(model, tok,
+                                            centers_per_step=128)
             for epoch in range(3):
-                loss, pairs = PSDeviceCorpusTrainer(
-                    model, tok, centers_per_step=128).train_epoch(
-                        seed=100 * rank + epoch)
+                loss, pairs = trainer.train_epoch(seed=100 * rank + epoch)
                 assert np.isfinite(loss) and pairs > 0
             mv.current_zoo().barrier()
             return topic_separation(model, d)
@@ -591,11 +591,11 @@ class TestPSDevicePipeline:
                     mv.current_zoo().barrier()
                 return None
             assert model._in_table._num_server == 2
+            trainer = PSDeviceCorpusTrainer(model, tok,
+                                            centers_per_step=128)
             losses = []
             for epoch in range(3):
-                loss, pairs = PSDeviceCorpusTrainer(
-                    model, tok, centers_per_step=128).train_epoch(
-                        seed=epoch)
+                loss, pairs = trainer.train_epoch(seed=epoch)
                 losses.append(loss / max(pairs, 1))
             assert losses[-1] < losses[0], losses
             return topic_separation(model, d)
