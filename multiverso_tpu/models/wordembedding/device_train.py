@@ -771,6 +771,38 @@ def _block_step_fn(C: int, W: int, K: int, cbow: bool = False,
     return jax.jit(step)
 
 
+@functools.lru_cache(maxsize=None)
+def _grouped_ids_fn(ids_fn, G: int):
+    """vmap an ids program over G blocks: one program launch prepares
+    G blocks' id sets (stacked on a leading axis) from one folded key
+    and a [G] base vector."""
+    mapped = jax.vmap(ids_fn, in_axes=(None, None, None, None, 0, 0,
+                                       None))
+
+    @jax.jit
+    def ids(kept_pad, ksent_pad, aux1, aux2, key, bases, n_kept):
+        keys = jax.random.split(key, G)
+        return mapped(kept_pad, ksent_pad, aux1, aux2, keys, bases,
+                      n_kept)
+
+    return ids
+
+
+@functools.lru_cache(maxsize=None)
+def _grouped_step_fn(step_fn, G: int):
+    """vmap a PS block step over G stacked blocks (per-block lr vector),
+    summing losses/examples — one program launch trains G blocks
+    against the group's shared pulled state."""
+    mapped = jax.vmap(step_fn, in_axes=(0, 0, 0, 0, None))
+
+    @jax.jit
+    def step(v, u, pmask, lrs, inv_workers):
+        d_v, d_u, loss, examples = mapped(v, u, pmask, lrs, inv_workers)
+        return d_v, d_u, loss.sum(), examples.sum()
+
+    return step
+
+
 class PSDeviceCorpusTrainer:
     """The PS twin of ``DeviceCorpusTrainer``: same HBM-resident corpus
     pipeline, but the embeddings live in PARAMETER-SERVER matrix tables
@@ -790,7 +822,17 @@ class PSDeviceCorpusTrainer:
     for cross-process runs."""
 
     def __init__(self, model, tokenized: TokenizedCorpus,
-                 centers_per_step: int = 32768):
+                 centers_per_step: int = 32768,
+                 blocks_per_dispatch: int = 1):
+        """``blocks_per_dispatch`` (G) batches G blocks' ids into ONE
+        pull/step/push round trip — G-fold fewer program launches (the
+        per-block cost that bounds the PS path on a tunneled chip), at
+        the price of G blocks reading the same table state before their
+        deltas land: the same bounded-staleness trade the reference
+        makes with -is_pipeline prefetch and sync_frequency > 1
+        (ref: distributed_wordembedding.cpp:203-224,
+        LogisticRegression configure.h sync_frequency). G=1 keeps exact
+        per-block semantics."""
         config = model.config
         if not getattr(model, "_device_path", False):
             raise ValueError("PS device pipeline needs in-process "
@@ -798,6 +840,7 @@ class PSDeviceCorpusTrainer:
         self.model = model
         self.config = config
         self._C = int(centers_per_step)
+        self._G = max(int(blocks_per_dispatch), 1)
         self._corpus = _CorpusOnDevice(model, tokenized)
         self._n_tokens = self._corpus.n_tokens
         if config.hs:
@@ -838,15 +881,19 @@ class PSDeviceCorpusTrainer:
                                 model._neg_alias_dev)
         self._pad = jax.jit(functools.partial(_pad_stream, self._C,
                                               config.window))
+        if self._G > 1:
+            self._ids = _grouped_ids_fn(self._ids, self._G)
+            self._step = _grouped_step_fn(self._step, self._G)
         self.kept_words_trained = 0
 
     def train_epoch(self, seed: int, block_hook=None,
                     max_steps: int = 0) -> Tuple[float, float]:
-        """One epoch: per block, compute ids on device -> device-key
-        pulls -> jitted step -> device-key delta pushes, all dispatched
-        asynchronously (losses accumulate as device scalars; pushes are
-        fire-and-forget until the trailing drain)."""
-        model, C = self.model, self._C
+        """One epoch: per dispatch group (G blocks; G=1 default),
+        compute ids on device -> device-key pulls -> jitted step ->
+        device-key delta pushes, all dispatched asynchronously (losses
+        accumulate as device scalars; pushes are fire-and-forget until
+        the trailing drain)."""
+        model, C, G = self.model, self._C, self._G
         in_table, out_table = model._in_table, model._out_table
         key = jax.random.PRNGKey(seed)
         key, prep_key = jax.random.split(key)
@@ -863,15 +910,32 @@ class PSDeviceCorpusTrainer:
         raw_per_step = self._n_tokens / max(math.ceil(n_kept / C), 1)
         loss_acc = None
         pair_acc = None
-        for s in range(steps):
-            step_key = jax.random.fold_in(key, s)
-            # in_ids: centers [C] (skip-gram) or the context window
-            # block [C, 2W] (CBOW); out_ids: [ctx | negs] or
-            # [center | negs] — see _block_ids_fn.
+        for g0 in range(0, steps, G):
+            real = min(G, steps - g0)
+            step_key = jax.random.fold_in(key, g0)
+            if G == 1:
+                base = np.int32(g0 * C)
+                lr = jnp.float32(model.learning_rate())
+                model._account_words(raw_per_step)
+            else:
+                # Padded tail blocks get base = n_kept (fully masked)
+                # and lr 0 — exact no-ops, so the program set stays one
+                # fixed shape.
+                bases = np.full(G, n_kept, np.int32)
+                bases[:real] = (np.arange(g0, g0 + real)
+                                * C).astype(np.int32)
+                lrs = np.zeros(G, np.float32)
+                for i in range(real):
+                    lrs[i] = model.learning_rate()
+                    model._account_words(raw_per_step)
+                base, lr = jnp.asarray(bases), jnp.asarray(lrs)
+            # in_ids: centers (skip-gram) or the band (CBOW); out_ids:
+            # [band | negs] / [centers | negs] / Huffman path rows —
+            # see _block_ids_fn / _block_ids_fn_hs; leading G axis when
+            # grouped.
             in_ids, out_ids, pmask = self._ids(
                 kept_pad, ksent_pad, self._aux_tables[0],
-                self._aux_tables[1], step_key, np.int32(s * C),
-                n_kept_dev)
+                self._aux_tables[1], step_key, base, n_kept_dev)
             # Device-key pulls ride the worker->server actor round trip;
             # the replies are lazy device arrays (no host sync).
             mid_in = in_table.get_rows_device_async(in_ids)
@@ -883,20 +947,18 @@ class PSDeviceCorpusTrainer:
             v = tuple(in_table.take_device_row_parts())
             u = tuple(out_table.take_device_row_parts())
             d_v, d_u, loss, pairs = self._step(
-                v, u, pmask, jnp.float32(model.learning_rate()),
-                jnp.float32(1.0 / model._num_workers))
+                v, u, pmask, lr, jnp.float32(1.0 / model._num_workers))
             # Fire-and-forget pushes: waiters self-reap on ack; the
             # trailing drain below bounds the epoch.
             model._pending_pushes.append(
                 (in_table, in_table.add_rows_async(in_ids, d_v)))
             model._pending_pushes.append(
                 (out_table, out_table.add_rows_async(out_ids, d_u)))
-            model._account_words(raw_per_step)
             loss_acc = loss if loss_acc is None else loss_acc + loss
             pair_acc = pairs if pair_acc is None else pair_acc + pairs
             self.last_loss = loss  # device scalar; bench sync point
             if block_hook is not None:
-                block_hook(raw_per_step)
+                block_hook(raw_per_step * real)
         model._drain_pushes()
         model._flush_word_count()
         model._in_table.zoo.barrier()

@@ -23,6 +23,7 @@ from ...util import log
 from ...util.configure import (define_bool, define_double, define_int,
                                define_string, get_flag, parse_cmd_flags)
 from .data import BlockLoader, TokenizedCorpus, iter_pair_batches
+from .device_train import DeviceCorpusTrainer, PSDeviceCorpusTrainer
 from .dictionary import Dictionary
 from .model import PSWord2Vec, Word2Vec, Word2VecConfig
 
@@ -48,6 +49,10 @@ define_bool("per_pair", False, "device pipelines, skip-gram: per-pair "
             "negatives + sequential window sub-steps (the reference's "
             "update structure; slower, reaches sequential-SGD quality)")
 define_bool("is_pipeline", True, "overlap loading with training")
+define_bool("device_pipeline", True, "train through the HBM-resident "
+            "device pipeline (the fast path; -batch_size/-is_pipeline "
+            "apply only to the host-batch loop); false = host-batch "
+            "loop (the cross-process-capable form)")
 define_string("stopwords", "", "optional stopwords file (one word per "
               "line) filtered out of the vocabulary — the reference "
               "reader's stopwords table (ref: Applications/WordEmbedding"
@@ -99,19 +104,40 @@ def run(argv=None) -> Word2Vec:
         model = Word2Vec(config, dictionary)
 
     corpus = TokenizedCorpus.build(dictionary, train_file)
+    # The DEVICE pipelines (corpus + windowing + sampling in HBM —
+    # models/wordembedding/device_train.py) are the fast path for every
+    # mode combination; -device_pipeline=false falls back to the
+    # host-batch loop (the form that also runs cross-process, and the
+    # only path for worker-only PS ranks whose servers live elsewhere).
+    device_ok = not config.use_ps or getattr(model, "_device_path", False)
+    use_device = get_flag("device_pipeline") and device_ok
+    if use_device:
+        log.info("training via the device pipeline "
+                 "(-batch_size/-is_pipeline apply to the host-batch "
+                 "loop only; -device_pipeline=false selects it)")
+        trainer = (PSDeviceCorpusTrainer(model, corpus)
+                   if config.use_ps else
+                   DeviceCorpusTrainer(model, corpus))
+
+        def train_one(epoch):
+            return trainer.train_epoch(seed=config.seed + epoch)
+    else:
+        def train_one(epoch):
+            batches = iter_pair_batches(
+                dictionary, corpus, batch_size=config.batch_size,
+                window=config.window, subsample=config.sample,
+                cbow=config.cbow, seed=config.seed + epoch)
+            # Row preparation runs in the loader thread (prepared()) so
+            # it overlaps with device steps; the hot loop lives in the
+            # model — local mode accumulates device losses without host
+            # syncs, PS mode pipelines pull/train/push.
+            iterator = BlockLoader(model.prepared(batches)) \
+                if get_flag("is_pipeline") else batches
+            return model.train_batches(iterator)
+
     start = time.perf_counter()
     for epoch in range(config.epochs):
-        batches = iter_pair_batches(
-            dictionary, corpus, batch_size=config.batch_size,
-            window=config.window, subsample=config.sample,
-            cbow=config.cbow, seed=config.seed + epoch)
-        # Row preparation runs in the loader thread (prepared()) so it
-        # overlaps with device steps; the hot loop lives in the model —
-        # local mode accumulates device losses without host syncs, PS
-        # mode pipelines pull/train/push.
-        iterator = BlockLoader(model.prepared(batches)) \
-            if get_flag("is_pipeline") else batches
-        loss_sum, pair_count = model.train_batches(iterator)
+        loss_sum, pair_count = train_one(epoch)
         elapsed = time.perf_counter() - start
         log.info("epoch %d: avg pair loss %.4f, %.0f words/s", epoch,
                  loss_sum / max(pair_count, 1),
