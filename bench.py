@@ -307,6 +307,42 @@ def run_ps(corpus: str, prebuilt=None) -> dict:
             "separation": round(float(separation), 4)}
 
 
+def run_hs(prebuilt) -> dict:
+    """Hierarchical softmax on the local device pipeline (banded
+    Huffman paths — one path gather per band position): a capped
+    timed segment reporting HS words/s (VERDICT r3 #5)."""
+    from multiverso_tpu.models.wordembedding import (DeviceCorpusTrainer,
+                                                     Word2Vec,
+                                                     Word2VecConfig)
+    dictionary, tokenized = prebuilt
+    config = Word2VecConfig(embedding_size=DIM, window=5, negative=0,
+                            hs=True, epochs=EPOCHS, sample=1e-3)
+    # Same warm-then-time protocol as run_local (throwaway model warms
+    # both donated-layout variants; drop it BEFORE the timed model so
+    # two sets of tables + corpus never coexist in HBM; sync the corpus
+    # upload or it lands inside the timed window).
+    warm_model = Word2Vec(config, dictionary)
+    DeviceCorpusTrainer(warm_model, tokenized, centers_per_step=8192,
+                        steps_per_dispatch=8).train_epoch(
+        seed=99, max_steps=16)
+    float(warm_model._emb_in[0, 0])
+    del warm_model
+    model = Word2Vec(config, dictionary)
+    trainer = DeviceCorpusTrainer(model, tokenized,
+                                  centers_per_step=8192,
+                                  steps_per_dispatch=8)
+    float(model._emb_in[0, 0])
+    float(trainer._corpus.flat[0])
+    start = time.perf_counter()
+    loss, pairs = trainer.train_epoch(seed=0, max_steps=160)
+    float(model._emb_in[0, 0])
+    elapsed = time.perf_counter() - start
+    return {"wps": round(model.trained_words / elapsed, 0),
+            "avg_loss": round(loss / max(pairs, 1), 4),
+            "centers_per_step": trainer._C,
+            "path_len": int(model._points_host.shape[1])}
+
+
 HOSTBATCH_SIZE = 131072  # the host-batch path is upload/dispatch bound
 #   per BLOCK, so the cross-process-capable segment uses reference-style
 #   big data blocks (the reference's loader also ships multi-sentence
@@ -944,6 +980,10 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001
         hostbatch = {"error": str(exc)[:200]}
     try:
+        hs = _phase("hs_train", run_hs, prebuilt)
+    except Exception as exc:  # noqa: BLE001
+        hs = {"error": str(exc)[:200]}
+    try:
         quality_local = _phase("quality_local", run_quality, prebuilt,
                                cpp_sep, False)
     except Exception as exc:  # noqa: BLE001
@@ -1016,6 +1056,7 @@ def main() -> None:
             "ps_median_batch_words_per_sec": ps["median_batch_wps"],
             "ps_hostbatch_words_per_sec": hostbatch.get("wps"),
             "ps_hostbatch_batch_size": hostbatch.get("batch_size"),
+            "hs_train": hs,
             "ps_vs_local": round(ps["wps"] / local["wps"], 3),
             "ps_avg_loss": ps["avg_loss"],
             "ps_topic_separation": ps["separation"],
