@@ -106,6 +106,10 @@ QUALITY_PS_GROUP = 4   # PS quality mode: 4 blocks per round trip — the
 #   largest grouping whose staleness still reaches the cpp separation
 #   (G=8 plateaus at ~0.87); 4x fewer per-block program launches makes
 #   the crossing time robust to tunnel launch weather
+QUALITY_WALL_BUDGET_SEC = 420.0  # wall guard for the quality phases:
+#   per-block program launches swing 5-50x with tunnel weather; a
+#   bad-weather run reports a partial curve instead of blowing the
+#   whole bench's runtime
 CPP_SEP_FALLBACK = 1.0305  # r3's measured cpp separation, used only if
 #   the cpp phase fails
 
@@ -443,11 +447,33 @@ def run_quality(prebuilt, cpp_sep: float, use_ps: bool) -> dict:
         float(model._emb_in[0, 0])
 
     start = time.perf_counter()
+    deadline = start + QUALITY_WALL_BUDGET_SEC
+
+    class _Deadline(Exception):
+        pass
+
+    def deadline_hook(words):
+        # Checked per dispatch group, so a single bad-weather epoch
+        # cannot blow the budget many times over before the first
+        # epoch-boundary check.
+        if time.perf_counter() > deadline:
+            raise _Deadline
+
+    hook_kw = {"block_hook" if use_ps else "group_hook": deadline_hook}
     curve = []
     losses = []
     time_to_quality = None
+    guard_tripped = False
     for epoch in range(QUALITY_EPOCHS):
-        loss, pairs = trainer.train_epoch(seed=epoch)
+        try:
+            loss, pairs = trainer.train_epoch(seed=epoch, **hook_kw)
+        except _Deadline:
+            guard_tripped = True
+            if use_ps:
+                # The aborted epoch left async pushes in flight; wait
+                # their acks so shutdown does not race the actors.
+                model._drain_pushes()
+            break
         losses.append(round(loss / max(pairs, 1), 4))
         sep = float(topic_separation(None, dictionary, fetch_rows=fetch))
         elapsed = time.perf_counter() - start
@@ -456,10 +482,14 @@ def run_quality(prebuilt, cpp_sep: float, use_ps: bool) -> dict:
         if sep >= cpp_sep and time_to_quality is None:
             time_to_quality = round(elapsed, 1)
             break  # record set; spend no more bench time here
+        if time.perf_counter() > deadline:
+            guard_tripped = True
+            break
     if use_ps:
         mv.shutdown()
     return {"time_to_cpp_quality_sec": time_to_quality,
             "cpp_separation_target": round(cpp_sep, 4),
+            "wall_guard_tripped": guard_tripped,
             "curve": curve, "epoch_losses": losses,
             "mode": "ps" if use_ps else "local"}
 
