@@ -532,10 +532,13 @@ def run_ps_two_workers(prebuilt, blocks: int = 48) -> dict:
 
 
 def run_ps_two_servers(prebuilt, blocks: int = 48) -> dict:
-    """A MEASURED 2-server number (VERDICT r3 #3): the device-key PS
-    pipeline against TWO in-process servers — ids broadcast, foreign
-    rows masked on device, replies summed. On one chip the extra
-    [k, D] pass per additional server is the cost being measured."""
+    """A MEASURED 2-server number (VERDICT r3 #3): the grouped
+    device-key PS pipeline against TWO in-process servers — ids
+    broadcast, foreign rows masked on device, replies summed in the
+    step. On ONE chip each server still processes the full key set, so
+    that work serializes and the honest same-window ratio is ~0.7x; on
+    separate chips (the deployment the protocol is for, exercised by
+    dryrun_multichip) the per-server gathers parallelize."""
     from multiverso_tpu.models.wordembedding import (PSDeviceCorpusTrainer,
                                                      PSWord2Vec,
                                                      Word2VecConfig)
@@ -553,8 +556,9 @@ def run_ps_two_servers(prebuilt, blocks: int = 48) -> dict:
             for _ in range(2):
                 mv.current_zoo().barrier()
             return None
-        trainer = PSDeviceCorpusTrainer(model, tokenized, PS_CENTERS)
-        trainer.train_epoch(seed=99, max_steps=2)  # warm
+        trainer = PSDeviceCorpusTrainer(model, tokenized, PS_CENTERS,
+                                        blocks_per_dispatch=PS_GROUP)
+        trainer.train_epoch(seed=99, max_steps=2 * PS_GROUP)  # warm
         w0 = model.trained_words
         t0 = time.perf_counter()
         trainer.train_epoch(seed=0, max_steps=blocks)
@@ -564,7 +568,23 @@ def run_ps_two_servers(prebuilt, blocks: int = 48) -> dict:
     cluster.timeout = 600.0
     results = cluster.run(body)
     words, elapsed = results[0]
-    return {"wps": round(words / elapsed, 0)}
+    wps = round(words / elapsed, 0)
+    # Same-window single-server reference: launch overhead swings with
+    # tunnel weather between phases, so the meaningful ratio compares
+    # back-to-back runs, not this phase against the earlier ps_train.
+    # In a 1-rank cluster ``body``'s server-only branch is unreachable,
+    # so the reference runs the IDENTICAL measured loop. A reference
+    # failure must not discard the already-measured 2-server number.
+    try:
+        single = LocalCluster(1)
+        single.timeout = 600.0
+        s_words, s_elapsed = single.run(body)[0]
+        s_wps = round(s_words / s_elapsed, 0)
+        ratio = round(wps / max(s_wps, 1), 3)
+    except Exception as exc:  # noqa: BLE001
+        s_wps, ratio = f"error: {str(exc)[:120]}", None
+    return {"wps": wps, "single_server_wps": s_wps,
+            "vs_single_same_window": ratio}
 
 
 _TCP_CHILD = r"""
@@ -1093,9 +1113,8 @@ def main() -> None:
             "ps_two_workers": two_workers,
             "ps_two_servers": two_servers,
             "tcp_cross_process": tcp,
-            "ps_two_servers_vs_single": round(
-                two_servers["wps"] / ps["wps"], 3)
-            if two_servers.get("wps") else None,
+            "ps_two_servers_vs_single": two_servers.get(
+                "vs_single_same_window"),
             "quality_local": quality_local,
             "quality_ps": quality_ps,
             "time_to_cpp_quality_sec": {
