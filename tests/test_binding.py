@@ -103,16 +103,42 @@ class TestParamManagers:
         callback()  # 2nd call: syncs
         assert manager.table.get().sum() == pytest.approx(2.0)
 
-    def test_torch_manager(self, env):
-        torch = pytest.importorskip("torch")
-        module = torch.nn.Linear(3, 2)
-        manager = TorchParamManager(module)
-        with torch.no_grad():
-            for p in module.parameters():
-                p.add_(1.0)
-        manager.sync_all_param()
-        merged = [p.detach().numpy() for p in module.parameters()]
-        assert all(np.isfinite(m).all() for m in merged)
+    def test_torch_manager(self):
+        # torch runs in a SUBPROCESS: importing it next to jax in the
+        # long-lived pytest process intermittently SIGABRTs at
+        # interpreter teardown (duplicate native runtimes) — observed
+        # ~1 in 4 full-suite runs before this isolation.
+        import importlib.util
+        if importlib.util.find_spec("torch") is None:
+            pytest.skip("torch not installed")
+        code = (
+            f"import sys; sys.path.insert(0, {BINDING_PATH!r})\n"
+            "import numpy as np, torch\n"
+            "import multiverso as mv_binding\n"
+            "from multiverso.ext import TorchParamManager\n"
+            "mv_binding.init()\n"
+            "module = torch.nn.Linear(3, 2)\n"
+            "manager = TorchParamManager(module)\n"
+            "with torch.no_grad():\n"
+            "    for p in module.parameters():\n"
+            "        p.add_(1.0)\n"
+            "manager.sync_all_param()\n"
+            "merged = [p.detach().numpy() for p in module.parameters()]\n"
+            "assert all(np.isfinite(m).all() for m in merged)\n"
+            "mv_binding.shutdown()\n"
+            "print('TORCH_OK')\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     PYTHONPATH=os.pathsep.join(
+                         p for p in (REPO,
+                                     os.environ.get("PYTHONPATH", ""))
+                         if p)))
+        # Assert on the marker, NOT the returncode: the teardown SIGABRT
+        # this subprocess exists to dodge fires AFTER the script's own
+        # asserts pass and the marker prints.
+        assert "TORCH_OK" in out.stdout, out.stderr[-500:]
 
     def test_jax_manager(self, env):
         import jax.numpy as jnp
@@ -278,6 +304,10 @@ class TestExamples:
         assert all(a > 0.8 for a in accs), out  # learns, not just runs
 
     def test_torch_mlp_example_two_workers(self):
-        pytest.importorskip("torch")
+        import importlib.util
+        if importlib.util.find_spec("torch") is None:
+            pytest.skip("torch not installed")  # find_spec, not import:
+        # loading torch into the pytest process intermittently aborts
+        # at teardown next to jax
         out = self._run("torch_mlp.py", 2)
         assert "accuracy" in out, out
