@@ -26,6 +26,7 @@ Measured and reported honestly (round-2 requirements):
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -447,7 +448,13 @@ def run_quality(prebuilt, cpp_sep: float, use_ps: bool) -> dict:
         float(model._emb_in[0, 0])
 
     start = time.perf_counter()
-    deadline = start + QUALITY_WALL_BUDGET_SEC
+    # The phase's own wall guard must also fit inside the GLOBAL bench
+    # budget: the phase-skip estimate assumes a typical run, and bad
+    # launch weather may legitimately push the phase to its cap — cap
+    # it at what the global budget has left (less a teardown margin).
+    global_left = (_BENCH_T0 + WALL_BUDGET_SEC) - time.monotonic() - 30.0
+    deadline = start + max(min(QUALITY_WALL_BUDGET_SEC, global_left),
+                           10.0)
 
     class _Deadline(Exception):
         pass
@@ -983,7 +990,7 @@ def matrix_bandwidth() -> dict:
 
 def _phase(name: str, fn, *args, **kw):
     """Run one bench phase with stderr progress + timing (stdout carries
-    only the final JSON line)."""
+    only cumulative JSON result lines — the last one wins)."""
     print(f"[bench] {name}...", file=sys.stderr, flush=True)
     start = time.perf_counter()
     out = fn(*args, **kw)
@@ -994,6 +1001,171 @@ def _phase(name: str, fn, *args, **kw):
 
 
 _phase.seconds = {}
+
+
+# ---------------------------------------------------------------------------
+# Loss-proof harness (VERDICT r4 #1): round 4's entire perf story died in a
+# driver timeout because the bench printed its single JSON line only at the
+# very end. Three defenses, in depth:
+#   1. EMIT AFTER EVERY PHASE — the cumulative result is reprinted to stdout
+#      as a complete JSON line after each phase lands; whatever kills the
+#      process, everything already finished is already on stdout (the
+#      driver parses the last complete JSON line).
+#   2. SIGTERM/SIGINT handler — `timeout` sends SIGTERM first; the handler
+#      prints one final cumulative line and exits, so even the in-flight
+#      phase's partial absence is recorded explicitly.
+#   3. GLOBAL WALL BUDGET — before each phase, elapsed + a conservative
+#      worst-case estimate is checked against the budget; phases that no
+#      longer fit are skipped with a note instead of being started.
+# Deterministic CPU baselines (cpp_baseline, cpu_baseline) are additionally
+# cached on disk keyed by a config+source hash (~12 min recovered per run).
+
+WALL_BUDGET_SEC = float(os.environ.get("BENCH_WALL_BUDGET", "1500"))
+_BENCH_T0 = time.monotonic()
+
+# Conservative worst-case phase costs (sec) on this platform, from the r3/r4
+# driver tails — used only for the skip decision, never for timing.
+_PHASE_EST = {
+    "write_corpus": 8, "build_dictionary": 25,
+    "cpp_baseline": 340, "cpu_baseline": 430,
+    "local_train": 100, "ps_train": 110,
+    "quality_local": 190, "quality_ps": 180,
+    "ps_hostbatch": 70, "hs_train": 60,
+    "ps_two_workers": 60, "ps_two_servers": 95,
+    "tcp_one_process": 65, "tcp_two_process": 110,
+    "matrix_bandwidth": 60,
+}
+
+
+class _Result:
+    """Cumulative bench result: phases merge fields in as they finish,
+    ``emit()`` prints the whole thing as one JSON line each time."""
+
+    def __init__(self):
+        self.doc = {
+            "metric": "wordembedding_words_per_sec_per_chip",
+            "value": None, "unit": "words/s", "vs_baseline": None,
+            "detail": {"phase_seconds": _phase.seconds,
+                       "wall_budget": {"budget_sec": WALL_BUDGET_SEC,
+                                       "skipped": [],
+                                       "interrupted": None}},
+        }
+
+    def merge(self, **fields) -> None:
+        self.doc["detail"].update(fields)
+
+    _last_json = "{}"
+
+    def emit(self) -> None:
+        self.doc["detail"]["wall_budget"]["elapsed_sec"] = round(
+            time.monotonic() - _BENCH_T0, 1)
+        # ONE write call per line: the SIGTERM handler may fire mid-emit
+        # and append its own line — a torn multi-part write would leave
+        # no complete final JSON line for the driver to parse.
+        self._last_json = json.dumps(self.doc)
+        sys.stdout.write(self._last_json + "\n")
+        sys.stdout.flush()
+
+    def run(self, name: str, fn, *args, **kw):
+        """Budget-checked phase: skip (recording why) if the worst-case
+        estimate no longer fits; emit the cumulative line after every
+        completion OR failure."""
+        elapsed = time.monotonic() - _BENCH_T0
+        est = kw.pop("est", None) or _PHASE_EST.get(name, 60)
+        if elapsed + est > WALL_BUDGET_SEC:
+            print(f"[bench] SKIP {name}: {elapsed:.0f}s elapsed + "
+                  f"~{est}s estimate exceeds {WALL_BUDGET_SEC:.0f}s "
+                  "budget", file=sys.stderr, flush=True)
+            self.doc["detail"]["wall_budget"]["skipped"].append(name)
+            return None
+        try:
+            return _phase(name, fn, *args, **kw)
+        except Exception as exc:  # noqa: BLE001 - a phase failure must
+            # not take down the phases that already landed or follow
+            print(f"[bench] {name} FAILED: {exc!r}", file=sys.stderr,
+                  flush=True)
+            self.merge(**{name + "_error": str(exc)[:300]})
+            return None
+        finally:
+            self.emit()
+
+
+def _install_kill_emitter(result: _Result) -> None:
+    import signal
+
+    def _on_kill(signum, frame):  # noqa: ARG001
+        # The main thread may be mid-merge (dict resizing) — a fresh
+        # json.dumps can raise mid-iteration. Fall back to re-printing
+        # the last complete serialized line: losing the "interrupted"
+        # marker is acceptable; losing the whole record is not.
+        try:
+            result.doc["detail"]["wall_budget"]["interrupted"] = \
+                signal.Signals(signum).name
+            result.emit()
+        except Exception:  # noqa: BLE001
+            sys.stdout.write(result._last_json + "\n")
+        sys.stdout.flush()
+        os._exit(98)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_kill)
+
+
+def _baseline_cache_path(name: str, src_paths) -> str:
+    """Cache file path for a deterministic baseline. Key = hash of the
+    bench config constants + the baseline's source files + the
+    bench-side logic they depend on; any edit invalidates."""
+    import hashlib
+    import inspect
+    h = hashlib.sha256()
+    h.update(repr((VOCAB, SENTENCES, WORDS_PER_SENTENCE, EPOCHS, BATCH,
+                   DIM, NEG, MIN_COUNT, NEG_BLOCK, LOCAL_CENTERS,
+                   LOCAL_DISPATCH)).encode())
+    # The baselines also depend on bench-side logic that is not in the
+    # constants: the corpus generator and the baseline runners (CLI
+    # args, compile flags, the cpu twin's run_local). Hash their SOURCE
+    # so editing any of them invalidates the cache.
+    for bench_fn in (write_corpus, _build, run_local, cpu_baseline,
+                     cpp_baseline):
+        h.update(inspect.getsource(bench_fn).encode())
+    for p in sorted(src_paths):
+        with open(p, "rb") as f:
+            h.update(f.read())
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, ".bench_cache",
+                        f"{name}-{h.hexdigest()[:16]}.json")
+
+
+def _cached_baseline(name: str, src_paths, fn, *args):
+    """Disk cache for the two DETERMINISTIC baselines: same corpus
+    constants + same sources => same numbers, so recomputing ~12 min of
+    CPU work every bench run is pure waste (VERDICT r4 weak #6). The
+    loss/separation fields are exactly reproducible; the cached TIMING
+    fields carry whatever load the populating run saw, which is why the
+    reply is marked ``cached`` (populate from an uncontended run)."""
+    path = _baseline_cache_path(name, src_paths)
+    if os.path.exists(path):
+        with open(path) as f:
+            out = json.load(f)
+        out["cached"] = True
+        print(f"[bench] {name}: cache hit ({os.path.basename(path)})",
+              file=sys.stderr, flush=True)
+        return out
+    out = fn(*args)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp_path = path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp_path, path)
+    return out
+
+
+def _baseline_est(name: str, src_paths) -> int:
+    """Skip-check estimate for a cached baseline: seconds when the
+    cache file exists, the worst-case recompute estimate otherwise."""
+    if os.path.exists(_baseline_cache_path(name, src_paths)):
+        return 10
+    return _PHASE_EST[name]
 
 
 def _enable_compilation_cache() -> None:
@@ -1012,152 +1184,166 @@ def _enable_compilation_cache() -> None:
 
 
 def main() -> None:
+    # Handler FIRST: the compilation-cache setup imports jax (slow cold)
+    # and a TERM landing before installation would die silently.
+    result = _Result()
+    _install_kill_emitter(result)
     _enable_compilation_cache()
+    here = os.path.dirname(os.path.abspath(__file__))
     tmp = tempfile.mkdtemp()
     corpus = os.path.join(tmp, "corpus.txt")
+    result.merge(setup={
+        "vocab_raw": VOCAB, "min_count": MIN_COUNT,
+        "sentences": SENTENCES, "epochs": EPOCHS, "batch": BATCH,
+        "dim": DIM, "negative": NEG, "neg_block": NEG_BLOCK,
+        "quality_mode": {"per_pair": True, "centers": QUALITY_C,
+                         "epochs": QUALITY_EPOCHS},
+        "ps_batches": PS_MAX_BATCHES,
+        "corpus": "synthetic 2-topic banded Zipf "
+                  "(no egress: enwik9 unavailable)"})
+    result.emit()  # a complete (if empty) line exists from second zero
     _phase("write_corpus", write_corpus, corpus)
     prebuilt = _phase("build_dictionary", _build, corpus)
-    try:
-        cpp = _phase("cpp_baseline", cpp_baseline, corpus, tmp,
-                     prebuilt[0])
-    except Exception as exc:  # noqa: BLE001 - report without a baseline
-        cpp = {"error": str(exc)[:200]}
-    cpp_sep = cpp.get("topic_separation", CPP_SEP_FALLBACK)
-    local = _phase("local_train", run_local, corpus, prebuilt)
-    ps = _phase("ps_train", run_ps, corpus, prebuilt)
-    try:
-        hostbatch = _phase("ps_hostbatch", run_hostbatch, prebuilt)
-    except Exception as exc:  # noqa: BLE001
-        hostbatch = {"error": str(exc)[:200]}
-    try:
-        hs = _phase("hs_train", run_hs, prebuilt)
-    except Exception as exc:  # noqa: BLE001
-        hs = {"error": str(exc)[:200]}
-    try:
-        quality_local = _phase("quality_local", run_quality, prebuilt,
-                               cpp_sep, False)
-    except Exception as exc:  # noqa: BLE001
-        quality_local = {"error": str(exc)[:200]}
-    try:
-        quality_ps = _phase("quality_ps", run_quality, prebuilt,
-                            cpp_sep, True)
-    except Exception as exc:  # noqa: BLE001
-        quality_ps = {"error": str(exc)[:200]}
-    try:
-        two_workers = _phase("ps_two_workers", run_ps_two_workers,
-                             prebuilt)
-    except Exception as exc:  # noqa: BLE001
-        two_workers = {"error": str(exc)[:200]}
-    try:
-        two_servers = _phase("ps_two_servers", run_ps_two_servers,
-                             prebuilt)
-    except Exception as exc:  # noqa: BLE001
-        two_servers = {"error": str(exc)[:200]}
-    try:
-        tcp1 = _phase("tcp_one_process", run_tcp_processes, corpus,
-                      prebuilt, 1, tmp)
-        tcp2 = _phase("tcp_two_process", run_tcp_processes, corpus,
-                      prebuilt, 2, tmp)
-        tcp = {"one_process": tcp1, "two_process": tcp2,
-               "two_vs_one": round(tcp2["aggregate_wps"]
-                                   / max(tcp1["aggregate_wps"], 1), 3),
-               "note": "CPU backend; this host has ONE core, so two "
-                       "processes time-share it"}
-    except Exception as exc:  # noqa: BLE001
-        tcp = {"error": str(exc)[:200]}
-    try:
-        cpu = _phase("cpu_baseline", cpu_baseline, corpus)
-    except Exception as exc:  # noqa: BLE001 - report without a baseline
-        cpu = None
-        baseline_err = str(exc)[:200]
-    util = utilization(local["pairs_per_sec"], local["centers_per_sec"])
-    matrix = _phase("matrix_bandwidth", matrix_bandwidth)
+    result.doc["detail"]["setup"]["vocab_actual"] = prebuilt[0].size
 
-    parity = None
-    if cpu:
+    # Phases run in IMPORTANCE order: if the wall budget truncates the
+    # run, what remains on stdout is the most valuable prefix. The two
+    # deterministic CPU baselines are disk-cached (first run pays, every
+    # later run is free), so cpp lands first cheaply and cpu can wait.
+    cpp_srcs = [os.path.join(here, "native", "baseline",
+                             "word2vec_baseline.cpp")]
+    cpp = result.run("cpp_baseline", _cached_baseline, "cpp_baseline",
+                     cpp_srcs, cpp_baseline, corpus, tmp, prebuilt[0],
+                     est=_baseline_est("cpp_baseline", cpp_srcs)) \
+        or {"error": "skipped or failed"}
+    cpp_sep = cpp.get("topic_separation", CPP_SEP_FALLBACK)
+    cpp_wps = cpp.get("words_per_sec")
+    result.merge(cpp_baseline=cpp)
+
+    local = result.run("local_train", run_local, corpus, prebuilt)
+    if local:
+        result.doc["value"] = round(local["wps"], 0)
+        if cpp_wps:
+            # The number to beat: the C++/OpenMP word2vec on this
+            # host's CPU (BASELINE.md north star: >=10x CPU words/sec).
+            result.doc["vs_baseline"] = round(local["wps"] / cpp_wps, 3)
+        result.merge(
+            local_median_batch_words_per_sec=local["median_batch_wps"],
+            # Pure host arithmetic — never gated on the device fetch.
+            utilization=utilization(local["pairs_per_sec"],
+                                    local["centers_per_sec"]))
+        result.doc["detail"]["mfu"] = \
+            result.doc["detail"]["utilization"]["mfu"]
+        try:
+            # Live device work (row gather + readback over the tunnel)
+            # — a transient failure here must not kill the later phases.
+            result.merge(
+                # Row-fetch form: np.asarray(model.embeddings) would
+                # pull the whole table over the host link for 48 rows.
+                local_topic_separation=round(float(topic_separation(
+                    None, local["dictionary"],
+                    fetch_rows=lambda ids: np.asarray(
+                        local["model"]._emb_in[ids]))), 4))
+        except Exception as exc:  # noqa: BLE001
+            result.merge(local_topic_separation_error=str(exc)[:200])
+        result.emit()
+
+    ps = result.run("ps_train", run_ps, corpus, prebuilt)
+    if ps:
+        result.merge(
+            ps_words_per_sec=round(ps["wps"], 0),
+            ps_grouped_words_per_sec=ps.get("grouped_wps"),
+            ps_blocks_per_dispatch=PS_GROUP,
+            ps_cold_words_per_sec=ps["cold_wps"],
+            ps_warmup_seconds=ps["warmup_seconds"],
+            ps_median_batch_words_per_sec=ps["median_batch_wps"],
+            ps_avg_loss=ps["avg_loss"],
+            ps_topic_separation=ps["separation"],
+            ps_dashboard=ps["dashboard"],
+            ps_xprof_trace_dir=ps["xprof_trace_dir"])
+        if local:
+            result.merge(ps_vs_local=round(ps["wps"] / local["wps"], 3))
+        result.emit()
+
+    quality_local = result.run("quality_local", run_quality, prebuilt,
+                               cpp_sep, False) or {}
+    quality_ps = result.run("quality_ps", run_quality, prebuilt,
+                            cpp_sep, True) or {}
+    result.merge(
+        quality_local=quality_local, quality_ps=quality_ps,
+        time_to_cpp_quality_sec={
+            "local": quality_local.get("time_to_cpp_quality_sec"),
+            "ps": quality_ps.get("time_to_cpp_quality_sec"),
+            "cpp_elapsed_sec": cpp.get("elapsed_sec")})
+
+    # Cross-process PS over TCP: the 2-process number is the record that
+    # must beat the C++ baseline (VERDICT r4 #3), so it runs BEFORE the
+    # 1-process continuity point.
+    tcp2 = result.run("tcp_two_process", run_tcp_processes, corpus,
+                      prebuilt, 2, tmp)
+    tcp = {"two_process": tcp2,
+           # None (not False) when either operand is missing: a skipped
+           # phase must not read as "lost to the baseline".
+           "beats_cpp_baseline": bool(
+               tcp2["aggregate_wps"] > cpp_wps)
+           if (tcp2 and cpp_wps) else None,
+           "note": "CPU backend; this host has ONE core, so two "
+                   "processes time-share it"}
+    result.merge(tcp_cross_process=tcp)
+
+    two_servers = result.run("ps_two_servers", run_ps_two_servers,
+                             prebuilt)
+    if two_servers:
+        result.merge(ps_two_servers=two_servers,
+                     ps_two_servers_vs_single=two_servers.get(
+                         "vs_single_same_window"))
+
+    matrix = result.run("matrix_bandwidth", matrix_bandwidth)
+    if matrix:
+        result.merge(matrix_table_bandwidth=matrix)
+
+    cpu_srcs = sorted(glob.glob(os.path.join(
+        here, "multiverso_tpu", "models", "wordembedding", "*.py")))
+    cpu = result.run("cpu_baseline", _cached_baseline, "cpu_baseline",
+                     cpu_srcs, cpu_baseline, corpus,
+                     est=_baseline_est("cpu_baseline", cpu_srcs))
+    if cpu and local:
         # Fixed-seed full-run comparison: the CPU twin runs ALL epochs
         # with the same seeds/config, so every epoch has a rel-diff.
         rel = [round(abs(t - c) / max(abs(c), 1e-9), 4)
                for t, c in zip(local["epoch_losses"],
                                cpu["epoch_losses"])]
-        parity = {
-            "tpu_epoch_losses": local["epoch_losses"],
-            "cpu_epoch_losses": cpu["epoch_losses"],
-            "epoch_rel_diff": rel,
-            "epoch0_rel_diff": rel[0] if rel else None,
-        }
-    cpp_wps = cpp.get("words_per_sec")
-    result = {
-        "metric": "wordembedding_words_per_sec_per_chip",
-        "value": round(local["wps"], 0),
-        "unit": "words/s",
-        # The number to beat: the C++/OpenMP word2vec on this host's
-        # CPU (BASELINE.md north star: >=10x MPI-CPU words/sec).
-        "vs_baseline": round(local["wps"] / cpp_wps, 3) if cpp_wps
-        else None,
-        "detail": {
-            "local_median_batch_words_per_sec": local["median_batch_wps"],
-            "cpp_baseline": cpp,
-            "ps_words_per_sec": round(ps["wps"], 0),
-            "ps_grouped_words_per_sec": ps.get("grouped_wps"),
-            "ps_blocks_per_dispatch": PS_GROUP,
-            "ps_cold_words_per_sec": ps["cold_wps"],
-            "ps_warmup_seconds": ps["warmup_seconds"],
-            "ps_median_batch_words_per_sec": ps["median_batch_wps"],
-            "ps_hostbatch_words_per_sec": hostbatch.get("wps"),
-            "ps_hostbatch_batch_size": hostbatch.get("batch_size"),
-            "hs_train": hs,
-            "ps_vs_local": round(ps["wps"] / local["wps"], 3),
-            "ps_avg_loss": ps["avg_loss"],
-            "ps_topic_separation": ps["separation"],
-            "ps_two_workers": two_workers,
-            "ps_two_servers": two_servers,
-            "tcp_cross_process": tcp,
-            "ps_two_servers_vs_single": two_servers.get(
-                "vs_single_same_window"),
-            "quality_local": quality_local,
-            "quality_ps": quality_ps,
-            "time_to_cpp_quality_sec": {
-                "local": quality_local.get("time_to_cpp_quality_sec"),
-                "ps": quality_ps.get("time_to_cpp_quality_sec"),
-                "cpp_elapsed_sec": cpp.get("elapsed_sec"),
-            },
-            "loss_curves": {
-                "cpp_epoch_losses": cpp.get("epoch_losses"),
-                "tpu_quality_epoch_losses":
-                    quality_local.get("epoch_losses"),
-                "tpu_fast_epoch_losses": local["epoch_losses"],
-            },
-            "ps_dashboard": ps["dashboard"],
-            "ps_xprof_trace_dir": ps["xprof_trace_dir"],
-            # Row-fetch form: np.asarray(model.embeddings) would pull
-            # the whole table over the host link for 48 scored rows.
-            "local_topic_separation": round(float(topic_separation(
-                None, local["dictionary"],
-                fetch_rows=lambda ids: np.asarray(
-                    local["model"]._emb_in[ids]))), 4),
-            "loss_parity": parity if parity else baseline_err,
-            "mfu": util["mfu"],
-            "utilization": util,
-            "cpu_backend_words_per_sec": round(cpu["wps"], 0) if cpu
-            else None,
-            "matrix_table_bandwidth": matrix,
-            "phase_seconds": dict(_phase.seconds),
-            "setup": {"vocab_raw": VOCAB,
-                      "vocab_actual": local["dictionary"].size,
-                      "min_count": MIN_COUNT,
-                      "sentences": SENTENCES,
-                      "epochs": EPOCHS, "batch": BATCH, "dim": DIM,
-                      "negative": NEG, "neg_block": NEG_BLOCK,
-                      "quality_mode": {"per_pair": True,
-                                       "centers": QUALITY_C,
-                                       "epochs": QUALITY_EPOCHS},
-                      "ps_batches": PS_MAX_BATCHES,
-                      "corpus": "synthetic 2-topic banded Zipf "
-                                "(no egress: enwik9 unavailable)"},
-        },
-    }
-    print(json.dumps(result))
+        result.merge(
+            cpu_backend_words_per_sec=round(cpu["wps"], 0),
+            loss_parity={"tpu_epoch_losses": local["epoch_losses"],
+                         "cpu_epoch_losses": cpu["epoch_losses"],
+                         "epoch_rel_diff": rel,
+                         "epoch0_rel_diff": rel[0] if rel else None})
+    result.merge(loss_curves={
+        "cpp_epoch_losses": cpp.get("epoch_losses"),
+        "tpu_quality_epoch_losses": quality_local.get("epoch_losses"),
+        "tpu_fast_epoch_losses": local["epoch_losses"] if local
+        else None})
+
+    hostbatch = result.run("ps_hostbatch", run_hostbatch, prebuilt)
+    if hostbatch:
+        result.merge(ps_hostbatch_words_per_sec=hostbatch.get("wps"),
+                     ps_hostbatch_batch_size=hostbatch.get("batch_size"))
+    hs = result.run("hs_train", run_hs, prebuilt)
+    if hs:
+        result.merge(hs_train=hs)
+    two_workers = result.run("ps_two_workers", run_ps_two_workers,
+                             prebuilt)
+    if two_workers:
+        result.merge(ps_two_workers=two_workers)
+    tcp1 = result.run("tcp_one_process", run_tcp_processes, corpus,
+                      prebuilt, 1, tmp)
+    if tcp1:
+        tcp["one_process"] = tcp1
+        if tcp2:
+            tcp["two_vs_one"] = round(tcp2["aggregate_wps"]
+                                      / max(tcp1["aggregate_wps"], 1), 3)
+    result.emit()
 
 
 if __name__ == "__main__":
