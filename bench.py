@@ -821,8 +821,7 @@ def utilization(pairs_per_sec: float, centers_per_sec: float,
     }
 
 
-def step_decomposition(local: dict, matrix: dict,
-                       window: int = 5) -> dict:
+def step_decomposition(local: dict, matrix: dict) -> dict:
     """MEASURED wall-clock decomposition of the banded local step
     (VERDICT r4 weak #4): convert the step's known row traffic into
     time shares using the SAME-RUN microbench rates (slope-timed
@@ -836,7 +835,7 @@ def step_decomposition(local: dict, matrix: dict,
                    "measured microbench rates; residual = elementwise "
                    "compute + fusion + XLA overhead"}
     sg = matrix.get("scatter_32k_rows_gbps")
-    gg = matrix.get("gather_32k_rows_gbps")
+    gg = matrix.get("gather_256k_rows_gbps")
     lm = matrix.get("program_launch_ms")
     total = 0.0
     if sg:
@@ -1014,16 +1013,25 @@ def matrix_bandwidth() -> dict:
             return t
         return lambda t: f(t, g)
 
+    # Gather slope needs a BIGGER row set than scatter: a 32K-row
+    # gather (~16 MB) finishes in ~0.2 ms, far under the min-of-3
+    # timing noise, and the r5.0 run measured a null slope. 256K rows
+    # per step puts the per-step cost well above the noise floor.
+    k_gather = 262144
+    ids_gather = jax.random.randint(jax.random.PRNGKey(1),
+                                    (12, k_gather), 0, num_row,
+                                    jnp.int32)
+
     def make_gather(g):
-        @_ft.partial(jax.jit, donate_argnums=0, static_argnums=1)
+        @_ft.partial(jax.jit, static_argnums=1)
         def f(t, g):
-            def body(t, i):
-                # Fold the gathered rows back into row 0 so the gather
-                # cannot be dead-code-eliminated; the k-row gather
-                # dominates the single-row update.
-                return t.at[0].add(t[i].sum(0)), 0.0
-            t, _ = jax.lax.scan(body, t, ids_scan[:g])
-            return t
+            def body(acc, i):
+                # Reduce the gathered rows into the carry scalar: the
+                # output depends on every gather, so none can be
+                # dead-code-eliminated.
+                return acc + t[i].sum(), None
+            acc, _ = jax.lax.scan(body, jnp.float32(0), ids_gather[:g])
+            return acc
         return lambda t: f(t, g)
 
     def make_sweep(g):
@@ -1043,13 +1051,13 @@ def matrix_bandwidth() -> dict:
         return round(io_bytes / slope_s / 1e9, 2)
 
     scatter_gbps = gbps(2 * k * 128 * 4, slope(make_scatter))
-    gather_gbps = gbps(k * 128 * 4, slope(make_gather))
+    gather_gbps = gbps(k_gather * 128 * 4, slope(make_gather))
     sweep_gbps = gbps(2 * num_row * 128 * 4, slope(make_sweep))
 
     return {"add_gbps": round(add_gbps, 3),
             "get_gbps": round(get_gbps, 3),
             "scatter_32k_rows_gbps": scatter_gbps,
-            "gather_32k_rows_gbps": gather_gbps,
+            "gather_256k_rows_gbps": gather_gbps,
             "table_sweep_gbps": sweep_gbps,
             "sparse_dirty_roundtrip_gbps": round(sparse_gbps, 3),
             "sparse_dirty_launch_cap_gbps": round(sparse_implied_cap, 3),
@@ -1129,6 +1137,9 @@ class _Result:
 
     def merge(self, **fields) -> None:
         self.doc["detail"].update(fields)
+        # Every merge lands on stdout immediately — "merged but not yet
+        # emitted" is exactly the window a kill would erase.
+        self.emit()
 
     _last_json = "{}"
 
@@ -1343,10 +1354,13 @@ def main() -> None:
 
     quality_local = result.run("quality_local", run_quality, prebuilt,
                                cpp_sep, False) or {}
+    # Merge EACH quality result as it lands (not after both): a kill
+    # during the second phase must not erase the first's record.
+    result.merge(quality_local=quality_local)
     quality_ps = result.run("quality_ps", run_quality, prebuilt,
                             cpp_sep, True) or {}
     result.merge(
-        quality_local=quality_local, quality_ps=quality_ps,
+        quality_ps=quality_ps,
         time_to_cpp_quality_sec={
             "local": quality_local.get("time_to_cpp_quality_sec"),
             "ps": quality_ps.get("time_to_cpp_quality_sec"),

@@ -61,6 +61,13 @@ _ALL_KEY = np.array([-1], dtype=np.int32)
 # (in-process extension; -1 keeps the reference's host-reply semantics,
 # ref: matrix_table.cpp:267-276 sentinel handling).
 _ALL_KEY_DEVICE_REPLY = np.array([-2], dtype=np.int32)
+# Sentinel -3: PRE-SEGMENTED device-key request — the caller already
+# split its (sorted) device ids into one slice per server, so each
+# server receives ONLY its segment instead of the full broadcast id set
+# (the device twin of the reference's per-server key bucketing,
+# ref: matrix_table.cpp:267-276; the round-4 broadcast+mask form made
+# every server process every key).
+_SEGMENTED_KEY = np.array([-3], dtype=np.int32)
 
 
 def _onebit_blobs(chunk: np.ndarray):
@@ -311,15 +318,68 @@ class MatrixWorker(WorkerTable):
             return functools.reduce(jnp.add, ordered)
         return jnp.concatenate(ordered, axis=0)
 
+    def get_rows_device_segments_async(self, segments) -> int:
+        """Pre-segmented device row pull: ``segments`` is one device id
+        vector PER SERVER (the caller computed per-server slices of its
+        sorted ids — e.g. inside the program that produced them, where
+        the searchsorted bounds are free). Each server receives ONLY
+        its segment; out-of-range entries (slice slack / padding)
+        gather as zero rows via the server's bounded gather. Replies
+        come back keyed by server id — consume with
+        ``take_device_row_parts`` and reassemble in the consumer's jit.
+
+        This is the per-server work-conserving form of the device-key
+        protocol: per-server gather cost follows the SEGMENT size, not
+        the full id count (ref per-server bucketing contract:
+        matrix_table.cpp:234-315)."""
+        CHECK(self._zoo.net.in_process,
+              "segmented device gets need in-process servers")
+        CHECK(len(segments) == self._num_server,
+              "one segment per server")
+        CHECK(all(is_device_array(s) for s in segments),
+              "segments must be device arrays")
+        CHECK(not self._compress, "device gets bypass wire compression")
+        self._dest, self._dest_rows = None, None
+        self._device_shards = {}
+        self._device_sum = False
+        return self.get_async_raw(Blob(_SEGMENTED_KEY.view(np.uint8)),
+                                  [Blob(s) for s in segments])
+
+    def add_rows_device_segments_async(self, segments, deltas,
+                                       option: Optional[AddOption] = None
+                                       ) -> int:
+        """Pre-segmented device row push: per-server (ids, delta) pairs;
+        each server scatter-adds only its segment (foreign/padding rows
+        mask out-of-range and drop). Same stateless-updater contract as
+        ``add_rows_async`` device keys."""
+        CHECK(self._zoo.net.in_process,
+              "segmented device adds need in-process servers")
+        CHECK(len(segments) == self._num_server
+              and len(deltas) == self._num_server,
+              "one (segment, delta) pair per server")
+        CHECK(self._updater_stateless,
+              "device-key row adds need a stateless updater "
+              "(default/sgd): duplicate ids must sum")
+        for seg, delta in zip(segments, deltas):
+            CHECK(is_device_array(seg) and is_device_array(delta),
+                  "segments and deltas must be device arrays")
+            CHECK(tuple(delta.shape) ==
+                  tuple(seg.shape) + (self.num_col,),
+                  "bad segment delta shape")
+        blobs = ([Blob(_SEGMENTED_KEY.view(np.uint8))]
+                 + [Blob(s) for s in segments]
+                 + [Blob(d) for d in deltas]
+                 + [self._option_blob(option)])
+        return self.request_async_raw(MsgType.Request_Add, blobs)
+
     def take_device_row_parts(self):
         """The raw per-server reply shards of the last device get
         WITHOUT assembling them — a consumer that feeds them into its
         own jit can fold the multi-server sum into that program instead
         of paying a separate device op (each eager dispatch costs
-        milliseconds over a tunneled link). Device-key shards arrive in
-        REPLY order, which is unspecified — valid only for commutative
-        reassembly (the sum); host-key shards are keyed by server id
-        and come back in server order."""
+        milliseconds over a tunneled link). Replies carry the origin
+        server id, so parts return in SERVER order (segmented pulls
+        rely on this; the broadcast sum is order-independent)."""
         shards = self._device_shards
         CHECK(shards is not None and len(shards) > 0,
               "no device row get outstanding")
@@ -437,8 +497,24 @@ class MatrixWorker(WorkerTable):
             return {sid: list(blobs) for sid in range(self._num_server)}
         keys = blobs[0].as_array(np.int32)
         out: Dict[int, List[Blob]] = {}
+        if keys.size == 1 and keys[0] == -3:
+            # Pre-segmented device-key request: the caller already
+            # split its ids per server — route segment s (and its delta
+            # for adds) to server s ONLY. Layout:
+            # Get: [-3, seg_0..seg_{S-1}]
+            # Add: [-3, seg_0..seg_{S-1}, delta_0..delta_{S-1}, option]
+            S = self._num_server
+            rest = blobs[1:]
+            if msg_type == MsgType.Request_Get:
+                CHECK(len(rest) == S, "segmented get: one id blob "
+                      "per server")
+                return {s: [rest[s]] for s in range(S)}
+            CHECK(len(rest) == 2 * S + 1, "segmented add: per-server "
+                  "ids + deltas + option")
+            return {s: [rest[s], rest[S + s], rest[2 * S]]
+                    for s in range(S)}
         if keys.size == 1 and keys[0] < 0:
-            # Only the two defined sentinels may go negative; a stray
+            # Only the defined sentinels may go negative; a stray
             # negative row id must fail fast here, not fan out as a
             # whole-table request with undefined server-side handling.
             CHECK(keys[0] in (-1, -2),
@@ -530,8 +606,9 @@ class MatrixWorker(WorkerTable):
         (ref: sparse_matrix_table.cpp:226-258), whose host-buffer reply
         is otherwise bounded by host<->device bandwidth."""
         CHECK(self.is_sparse, "dirty gets are for sparse tables")
-        CHECK(self._num_server == 1 and self._zoo.net.in_process,
-              "device dirty gets need an in-process single server")
+        CHECK(self._zoo.net.in_process,
+              "device dirty gets need in-process servers (the reply "
+              "payload is a live device array)")
         self._dest, self._dest_rows = None, None
         self._device_shards = {}
         self._device_sum = False
@@ -540,8 +617,19 @@ class MatrixWorker(WorkerTable):
             Blob(_ALL_KEY_DEVICE_REPLY.view(np.uint8))))
         shards, ids = self._device_shards, self._device_shard_ids
         self._device_shards, self._device_shard_ids = None, None
-        CHECK(len(shards) == 1, "single-server dirty get: one reply")
-        return ids[0], shards[0]
+        CHECK(len(shards) == self._num_server,
+              "dirty get: one reply per server")
+        if self._num_server == 1:
+            return ids[0], shards[0]
+        # Each server's dirty set is sorted within its own row range and
+        # ranges are ordered by server id, so concatenation in server
+        # order is globally sorted — same contract as the single-server
+        # reply (ref: sparse_matrix_table.cpp:226-258 per-server dirty
+        # scan; reassembly is the worker's).
+        import jax.numpy as jnp
+        order = sorted(shards)
+        return (np.concatenate([ids[s] for s in order]),
+                jnp.concatenate([shards[s] for s in order], axis=0))
 
     # -- device-resident whole-table Get (shards stay in HBM) --
     def get_device(self):
@@ -556,13 +644,15 @@ class MatrixWorker(WorkerTable):
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
         if reply_blobs[0].on_device:
             # Device-key reply: values arrive shaped
-            # row_ids.shape + (num_col,), still in HBM. Multi-server
-            # replies all carry the SAME (shared) id blob, so key by
-            # arrival order — take_device_rows sums them.
+            # row_ids.shape + (num_col,), still in HBM — keyed by the
+            # origin server id (broadcast replies sum, order-free;
+            # segmented replies reassemble positionally, so server
+            # attribution matters).
             CHECK(self._device_shards is not None,
                   "device reply with no device get outstanding")
-            self._device_shards[len(self._device_shards)] = \
-                reply_blobs[1].typed(self.dtype)
+            sid = int(reply_blobs[2].as_array(np.int32)[0]) \
+                if len(reply_blobs) >= 3 else len(self._device_shards)
+            self._device_shards[sid] = reply_blobs[1].typed(self.dtype)
             return
         keys = reply_blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -1:
@@ -581,12 +671,15 @@ class MatrixWorker(WorkerTable):
         if self._device_shards is not None:
             # Device row pull: keep the server's gather result in HBM,
             # keyed by the owning server (a shard carries one server's
-            # contiguous key segment). The dirty-device flow
-            # additionally records the reply's row ids (and may reply
-            # zero rows).
-            sid = 0 if keys.size == 0 else \
-                int(min(keys[0] // self._row_length,
-                        self._num_server - 1))
+            # contiguous key segment). The dirty-device flow replies
+            # [ids, values, server_id] — possibly ZERO rows, so the
+            # server id cannot be inferred from the keys.
+            if len(reply_blobs) >= 3:
+                sid = int(reply_blobs[2].as_array(np.int32)[0])
+            else:
+                sid = 0 if keys.size == 0 else \
+                    int(min(keys[0] // self._row_length,
+                            self._num_server - 1))
             self._device_shards[sid] = _shaped_rows(
                 reply_blobs[1].typed(self.dtype), keys.size, self.num_col)
             if self._device_shard_ids is not None:
@@ -766,7 +859,11 @@ class MatrixServer(ServerTable):
             rows = blobs[0].typed(np.int32)
             gather = self._gather if self._shard_bounds is None \
                 else self._gather_bounded
-            return [blobs[0], Blob(gather(self._data, rows))]
+            # The server id rides along so the worker can key the reply
+            # shard by ORIGIN server — segmented pulls reassemble
+            # positionally and cannot rely on arrival order.
+            return [blobs[0], Blob(gather(self._data, rows)),
+                    Blob(np.array([self.server_id], dtype=np.int32))]
         keys = blobs[0].as_array(np.int32)
         if keys.size == 1 and keys[0] == -2:
             CHECK(self._up_to_date is not None and len(blobs) >= 2,
@@ -803,9 +900,13 @@ class MatrixServer(ServerTable):
 
     def _sparse_get_all_device(self, opt: GetOption) -> List[Blob]:
         """Dirty rows with the values left in HBM (host ids, device
-        payload; no wire filter — this path never crosses a wire)."""
+        payload; no wire filter — this path never crosses a wire). The
+        server id rides along: a server with ZERO dirty rows replies an
+        empty id vector, which the worker could not attribute by key
+        range (multi-server replies would collide on a guessed id)."""
         dirty, values = self._dirty_rows(opt)
-        return [Blob(dirty + self.row_offset), Blob(values)]
+        return [Blob(dirty + self.row_offset), Blob(values),
+                Blob(np.array([self.server_id], dtype=np.int32))]
 
     def _dirty_rows(self, opt: GetOption):
         wid = opt.worker_id

@@ -72,14 +72,21 @@ class WorkerTable:
 
     def add_async_raw(self, keys: Blob, values: Blob,
                       option_blob: Optional[Blob] = None) -> int:
-        msg_id = self._new_request()
-        msg = Message(src=self._zoo.rank, dst=-1,
-                      msg_type=MsgType.Request_Add,
-                      table_id=self.table_id, msg_id=msg_id)
-        msg.push(keys)
-        msg.push(values)
+        blobs = [keys, values]
         if option_blob is not None:
-            msg.push(option_blob)
+            blobs.append(option_blob)
+        return self.request_async_raw(MsgType.Request_Add, blobs)
+
+    def request_async_raw(self, msg_type: MsgType,
+                          blobs: Sequence[Blob]) -> int:
+        """Generic async request with an arbitrary blob layout — the
+        table subclass's ``partition`` defines what the blobs mean
+        (e.g. the matrix table's pre-segmented device-key requests)."""
+        msg_id = self._new_request()
+        msg = Message(src=self._zoo.rank, dst=-1, msg_type=msg_type,
+                      table_id=self.table_id, msg_id=msg_id)
+        for blob in blobs:
+            msg.push(blob)
         self._zoo.send_to(actors.WORKER, msg)
         return msg_id
 

@@ -350,8 +350,14 @@ class TestDeviceResidentPath:
                            np.ones((2, 4), np.float32))
         with pytest.raises(Exception, match="out of range"):
             table.get_rows(np.array([16], np.int32))
-        # Defense in depth: partition itself also rejects non-sentinels.
+        # Defense in depth: partition itself also rejects non-sentinels
+        # (-3 is the segmented-request marker, so the stray probe uses
+        # -4; a bare -3 with no segment blobs fails its own layout
+        # CHECK).
         with pytest.raises(Exception, match="sentinel"):
+            table.partition([Blob(np.array([-4], np.int32).view(np.uint8))],
+                            MsgType.Request_Get)
+        with pytest.raises(Exception, match="one id blob per server"):
             table.partition([Blob(np.array([-3], np.int32).view(np.uint8))],
                             MsgType.Request_Get)
 
@@ -543,6 +549,35 @@ class TestMultiRank:
         results = LocalCluster(2, argv=["-sync=true"]).run(body)
         for seen in results:
             assert seen == [2.0, 4.0, 6.0]  # both workers' adds, per round
+
+    def test_sparse_dirty_device_two_servers(self):
+        # Device-reply dirty pulls across a 2-server partition (the
+        # reference's dirty tracking works for any server count,
+        # ref: sparse_matrix_table.cpp:226-258): per-server dirty sets
+        # concatenate globally sorted; a server with zero dirty rows
+        # contributes an empty segment (attributed by the server-id
+        # blob, not by guessing from keys).
+        def body(rank):
+            import jax.numpy as jnp
+            table = mv.create_matrix_table(16, 4, is_sparse=True)
+            zoo = mv.current_zoo()
+            ids0, vals0 = table.get_dirty_device()  # initial: all dirty
+            ok0 = ids0.size == 16 and vals0.shape == (16, 4)
+            zoo.barrier()
+            rows = np.array([2, 9, 13], np.int32)  # spans both ranges
+            if rank == 0:
+                table.add_rows(rows, jnp.ones((3, 4), jnp.float32),
+                               option=AddOption(worker_id=0))
+            zoo.barrier()
+            ids, vals = table.get_dirty_device()
+            zoo.barrier()
+            return ok0, ids.tolist(), float(np.asarray(vals).sum())
+
+        r0, r1 = LocalCluster(2).run(body)
+        # The adder's own flags stay clean; the other worker sees the
+        # dirty rows from both servers, in global order.
+        assert r0 == (True, [], 0.0)
+        assert r1 == (True, [2, 9, 13], 12.0)
 
     def test_kv_two_servers(self):
         def body(rank):

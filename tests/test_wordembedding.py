@@ -681,6 +681,49 @@ class TestPSDevicePipeline:
         seps = LocalCluster(2, roles=["all", "server"]).run(body)
         assert seps[0] is not None and seps[0] > 0.3, seps
 
+    @pytest.mark.parametrize("grouped", [1, 2])
+    def test_ps_device_segmented_matches_broadcast(self, tmp_path,
+                                                   grouped):
+        # Round 5: per-server SEGMENTED device keys (each server gets a
+        # calibrated slice of the sorted ids) must train to the same
+        # tables as the broadcast+mask form — same update math, leaner
+        # routing (ref: src/table/matrix_table.cpp:234-315). Pulled
+        # rows reassemble to identical values; only duplicate-id
+        # scatter-add order may differ, so allow float slop.
+        from multiverso_tpu.models.wordembedding import (
+            PSDeviceCorpusTrainer, PSWord2Vec, TokenizedCorpus)
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+
+        def run(segment):
+            def body(rank):
+                config = Word2VecConfig(embedding_size=16, window=3,
+                                        epochs=2,
+                                        init_learning_rate=0.01,
+                                        batch_size=1024, sample=0)
+                model = PSWord2Vec(config, d)
+                if rank == 1:  # server-only rank holds the second shard
+                    for _ in range(2):
+                        mv.current_zoo().barrier()
+                    return None
+                trainer = PSDeviceCorpusTrainer(
+                    model, tok, centers_per_step=128,
+                    blocks_per_dispatch=grouped,
+                    segment_keys=segment)
+                for epoch in range(2):
+                    trainer.train_epoch(seed=epoch)
+                assert (trainer._seg_ids is not None) == segment
+                return model._in_table.get_rows(
+                    np.arange(d.size, dtype=np.int32))
+            return LocalCluster(2, roles=["all", "server"]).run(body)[0]
+
+        broadcast, segmented = run(False), run(True)
+        np.testing.assert_allclose(segmented, broadcast, rtol=1e-4,
+                                   atol=1e-6)
+
 
 class TestBatchGroup:
     @pytest.mark.parametrize("mode", ["sgns", "cbow", "hs"])
