@@ -827,7 +827,10 @@ def _segmented_ids_fn(ids_fn, offsets: tuple, caps_in: tuple,
         n = flat.shape[0]
         order = jnp.argsort(flat)
         sorted_ids = flat[order]
-        inv = jnp.argsort(order)
+        # Inverse permutation by scatter (one pass) — a second argsort
+        # would pay a full sort for what is just order[j] -> j.
+        inv = jnp.zeros_like(order).at[order].set(
+            jnp.arange(n, dtype=order.dtype))
         bounds = jnp.concatenate([
             jnp.zeros(1, jnp.int32),
             jnp.searchsorted(sorted_ids, jnp.asarray(offs)).astype(
@@ -931,7 +934,7 @@ class PSDeviceCorpusTrainer:
     def __init__(self, model, tokenized: TokenizedCorpus,
                  centers_per_step: int = 32768,
                  blocks_per_dispatch: int = 1,
-                 segment_keys: bool = None):
+                 segment_keys: bool = False):
         """``blocks_per_dispatch`` (G) batches G blocks' ids into ONE
         pull/step/push round trip — G-fold fewer program launches (the
         per-block cost that bounds the PS path on a tunneled chip), at
@@ -942,11 +945,16 @@ class PSDeviceCorpusTrainer:
         LogisticRegression configure.h sync_frequency). G=1 keeps exact
         per-block semantics.
 
-        ``segment_keys`` (default: on when the tables span >1 server)
-        sends each server a calibrated-capacity SLICE of the sorted ids
-        instead of broadcasting the full set — per-server gather/
-        scatter work follows the segment size (ref per-server key
-        bucketing: src/table/matrix_table.cpp:234-315)."""
+        ``segment_keys`` sends each server a calibrated-capacity SLICE
+        of the sorted ids instead of broadcasting the full set —
+        per-server gather/scatter work follows the segment size (ref
+        per-server key bucketing: src/table/matrix_table.cpp:234-315).
+        Default OFF: on one chip with Zipf-skewed ids the reorder
+        passes (sort + two [k, D] permutes + reassembly) cost more
+        than the per-server savings — measured 0.59x vs broadcast's
+        0.83x same-window on the bench corpus (scratch/seg_ratio.py);
+        it pays off when ids spread evenly across servers (balanced /
+        hashed tables), so it stays available as an opt-in."""
         config = model.config
         if not getattr(model, "_device_path", False):
             raise ValueError("PS device pipeline needs in-process "
@@ -999,8 +1007,7 @@ class PSDeviceCorpusTrainer:
             self._ids = _grouped_ids_fn(self._ids, self._G)
             self._step = _grouped_step_fn(self._step, self._G)
         num_server = model._in_table._num_server
-        self._segment_keys = (num_server > 1) if segment_keys is None \
-            else (bool(segment_keys) and num_server > 1)
+        self._segment_keys = bool(segment_keys) and num_server > 1
         self._seg_ids = None
         self._seg_step = None
         self._overflow = None

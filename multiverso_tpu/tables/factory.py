@@ -76,7 +76,8 @@ def create_matrix_table(num_row: int, num_col: int, dtype=np.float32,
                      seed=seed)
     if is_worker(role):
         worker = MatrixWorker(num_row, num_col, dtype,
-                              is_sparse=is_sparse, zoo=zoo,
+                              is_sparse=is_sparse,
+                              is_pipeline=is_pipeline, zoo=zoo,
                               updater_type=updater_type)
     zoo.barrier()
     return worker

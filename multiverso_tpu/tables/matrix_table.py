@@ -68,6 +68,12 @@ _ALL_KEY_DEVICE_REPLY = np.array([-2], dtype=np.int32)
 # ref: matrix_table.cpp:267-276; the round-4 broadcast+mask form made
 # every server process every key).
 _SEGMENTED_KEY = np.array([-3], dtype=np.int32)
+# Sentinel -4: FUSED sparse add + dirty get — semantically the exact
+# composition of add_rows and get_dirty_device, executed as ONE device
+# program server-side (on a tunneled device each big-argument program
+# launch costs more than the work; the 2-program roundtrip is launch-
+# bound, and fusing halves it).
+_ADD_GET_DIRTY_KEY = np.array([-4], dtype=np.int32)
 
 
 def _onebit_blobs(chunk: np.ndarray):
@@ -155,13 +161,18 @@ class MatrixTableOption:
 
 class MatrixWorker(WorkerTable):
     def __init__(self, num_row: int, num_col: int, dtype=np.float32,
-                 is_sparse: bool = False, zoo=None,
-                 updater_type: Optional[str] = None):
+                 is_sparse: bool = False, is_pipeline: bool = False,
+                 zoo=None, updater_type: Optional[str] = None):
         super().__init__(zoo=zoo)
         self.num_row = int(num_row)
         self.num_col = int(num_col)
         self.dtype = np.dtype(dtype)
         self.is_sparse = bool(is_sparse)
+        # Consumer-slot count, mirroring the server's bitmap height —
+        # lets caller-side CHECKs reject a bad consumer id instead of
+        # hanging on a reply the server actor will never send.
+        self._num_consumers = max(self._zoo.num_workers, 1) \
+            * (2 if is_pipeline else 1)
         # Device-key row adds may carry duplicate ids, which only sum
         # correctly under stateless rules. The server-side engine CHECK
         # fires inside the server actor, where _safe_dispatch swallows it
@@ -513,6 +524,17 @@ class MatrixWorker(WorkerTable):
                   "ids + deltas + option")
             return {s: [rest[s], rest[S + s], rest[2 * S]]
                     for s in range(S)}
+        if keys.size == 1 and keys[0] == -4 \
+                and msg_type == MsgType.Request_Get:
+            # Fused add+dirty-get (a Get — it replies): single-server
+            # (enforced in the caller) — the whole blob list goes to
+            # server 0. A Request_Add carrying -4 falls through to the
+            # stray-negative fail-fast below.
+            CHECK(self._num_server == 1 and len(blobs) in (5, 6),
+                  "fused add+dirty-get: [marker, rows, delta, "
+                  "add_option, get_option(, device rows)] to one "
+                  "server")
+            return {0: list(blobs)}
         if keys.size == 1 and keys[0] < 0:
             # Only the defined sentinels may go negative; a stray
             # negative row id must fail fast here, not fan out as a
@@ -630,6 +652,65 @@ class MatrixWorker(WorkerTable):
         order = sorted(shards)
         return (np.concatenate([ids[s] for s in order]),
                 jnp.concatenate([shards[s] for s in order], axis=0))
+
+    def add_get_dirty_device(self, row_ids, delta,
+                             option: Optional[AddOption] = None,
+                             get_worker: Optional[int] = None,
+                             row_ids_device=None):
+        """FUSED add + dirty pull: apply a row delta, then return THIS
+        worker's dirty rows — the exact composition of ``add_rows`` and
+        ``get_dirty_device``, but one request and ONE device program
+        server-side (the separate pair is bound by two big-argument
+        program launches on a tunneled device). Single in-process
+        server, async mode (a hidden add inside a Get would bypass the
+        BSP vector clocks). ``option`` names the adder as usual;
+        ``get_worker`` the dirty-set consumer (default: this worker).
+
+        ``row_ids_device``: optional DEVICE mirror of ``row_ids`` — a
+        caller pushing the same (or precomputed) row set repeatedly
+        keeps the ids in HBM, skipping the per-call id upload that
+        otherwise rides the tunnel (host ids are still required for
+        the dirty bookkeeping, which is a host bitmap). Stateless
+        updaters only, as with device-key adds."""
+        CHECK(self.is_sparse, "fused add+dirty-get is for sparse tables")
+        CHECK(self._num_server == 1 and self._zoo.net.in_process,
+              "fused add+dirty-get is a single-server in-process "
+              "extension (multi-server callers compose add_rows + "
+              "get_dirty_device)")
+        CHECK(not bool(get_flag("sync", False)),
+              "fused add+dirty-get is async-only: the embedded add "
+              "would bypass the BSP vector clocks")
+        row_ids = np.ascontiguousarray(row_ids,
+                                       dtype=np.int32).reshape(-1)
+        self._check_row_ids(row_ids)
+        CHECK(is_device_array(delta), "fused add needs a device delta")
+        CHECK(tuple(delta.shape) == (row_ids.size, self.num_col),
+              "bad delta shape")
+        if get_worker is None:
+            get_worker = max(self._zoo.worker_id, 0)
+        CHECK(0 <= int(get_worker) < self._num_consumers,
+              "get_worker out of the consumer-slot range (the "
+              "server-side CHECK would fire inside the actor and the "
+              "caller would hang)")
+        self._dest, self._dest_rows = None, None
+        self._device_shards = {}
+        self._device_sum = False
+        self._device_shard_ids = {}
+        blobs = [Blob(_ADD_GET_DIRTY_KEY.view(np.uint8)),
+                 Blob(row_ids.view(np.uint8)), Blob(delta),
+                 self._option_blob(option),
+                 GetOption(int(get_worker)).to_blob()]
+        if row_ids_device is not None:
+            CHECK(is_device_array(row_ids_device),
+                  "row_ids_device must be a device array")
+            CHECK(self._updater_stateless,
+                  "device-id fused adds need a stateless updater")
+            blobs.append(Blob(row_ids_device))
+        self.wait(self.request_async_raw(MsgType.Request_Get, blobs))
+        shards, ids = self._device_shards, self._device_shard_ids
+        self._device_shards, self._device_shard_ids = None, None
+        CHECK(len(shards) == 1, "fused dirty get: one reply")
+        return ids[0], shards[0]
 
     # -- device-resident whole-table Get (shards stay in HBM) --
     def get_device(self):
@@ -770,6 +851,9 @@ class MatrixServer(ServerTable):
         consumers = num_workers * (2 if is_pipeline else 1)
         self._up_to_date = np.zeros((consumers, self.my_rows), dtype=bool) \
             if is_sparse else None
+        # (dirty_ids, padded device ids) of the last fused dirty get —
+        # an unchanged dirty set skips the per-call id upload.
+        self._dirty_dev_cache = None
 
     # -- Add (ref: matrix_table.cpp:386-418, sparse_matrix_table.cpp:200-223)
     def process_add(self, blobs: List[Blob]) -> None:
@@ -865,6 +949,8 @@ class MatrixServer(ServerTable):
             return [blobs[0], Blob(gather(self._data, rows)),
                     Blob(np.array([self.server_id], dtype=np.int32))]
         keys = blobs[0].as_array(np.int32)
+        if keys.size == 1 and keys[0] == -4:
+            return self._fused_add_get_dirty(blobs)
         if keys.size == 1 and keys[0] == -2:
             CHECK(self._up_to_date is not None and len(blobs) >= 2,
                   "-2 sentinel is the sparse dirty device-reply get")
@@ -892,6 +978,46 @@ class MatrixServer(ServerTable):
             return _compress_values(np.asarray(values))
         return [Blob(values)]
 
+    def _fused_add_get_dirty(self, blobs: List[Blob]) -> List[Blob]:
+        """-4: apply a row add, then reply the get-worker's dirty rows
+        gathered from the UPDATED table — ONE compiled program instead
+        of the separate scatter + gather pair (whose two big-argument
+        launches bound the roundtrip on a tunneled device). Exact
+        composition of process_add(rows) + _sparse_get_all_device:
+        same dirty bookkeeping, same reply layout. Tunnel-traffic
+        trims: the caller may ship a device mirror of the add ids
+        (blob 5), and an unchanged dirty set reuses its cached device
+        id vector instead of re-uploading ~0.5 MB per call."""
+        CHECK(self._up_to_date is not None and len(blobs) in (5, 6),
+              "-4 is the fused sparse add+dirty-get")
+        rows = blobs[1].as_array(np.int32)
+        delta = blobs[2].typed(self.dtype)
+        add_opt = AddOption.from_blob(blobs[3])
+        get_opt = GetOption.from_blob(blobs[4])
+        local = rows - self.row_offset
+        self._mark_dirty(local, add_opt)
+        dirty = self._dirty_ids(get_opt.worker_id)
+        if len(blobs) == 6:
+            # Device mirror of the add ids — single server owns row
+            # offset 0, so global ids ARE local ids.
+            add_ids = blobs[5].typed(np.int32)
+        else:
+            add_ids = pad_ids(local, self._data.shape[0])
+        cached = self._dirty_dev_cache
+        if cached is not None and np.array_equal(cached[0], dirty):
+            get_ids = cached[1]
+        else:
+            import jax.numpy as jnp
+            get_ids = jnp.asarray(pad_ids(dirty, self._data.shape[0]))
+            self._dirty_dev_cache = (dirty, get_ids)
+        self._data, values = self._engine.apply_rows_gather(
+            self._data, add_ids,
+            _shaped_rows(delta, rows.size, self.num_col), add_opt,
+            get_ids, self.num_col)
+        return [Blob(dirty + self.row_offset),
+                Blob(_trim_rows(values, dirty.size)),
+                Blob(np.array([self.server_id], dtype=np.int32))]
+
     def _sparse_get_all(self, opt: GetOption) -> List[Blob]:
         """Return only this worker's dirty rows
         (ref: sparse_matrix_table.cpp:226-258)."""
@@ -908,11 +1034,17 @@ class MatrixServer(ServerTable):
         return [Blob(dirty + self.row_offset), Blob(values),
                 Blob(np.array([self.server_id], dtype=np.int32))]
 
-    def _dirty_rows(self, opt: GetOption):
-        wid = opt.worker_id
+    def _dirty_ids(self, wid: int) -> np.ndarray:
+        """The consumer's dirty row set, flipped clean on read — the
+        ONE copy of the bookkeeping shared by the composed and fused
+        dirty paths (they must never diverge)."""
         CHECK(0 <= wid < self._up_to_date.shape[0], "bad worker id")
         dirty = np.nonzero(~self._up_to_date[wid])[0].astype(np.int32)
         self._up_to_date[wid, dirty] = True
+        return dirty
+
+    def _dirty_rows(self, opt: GetOption):
+        dirty = self._dirty_ids(opt.worker_id)
         padded_rows = pad_ids(dirty, self._data.shape[0])
         values = _trim_rows(self._gather(self._data, padded_rows),
                             dirty.size)

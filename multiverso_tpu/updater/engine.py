@@ -88,9 +88,11 @@ class UpdateEngine:
                                   worker_id)
 
         self._pad_cols = pad_cols
+        self._pad_row_count = pad_row_count
         self._dense = jax.jit(dense_padded, donate_argnums=(0, 1))
         self._rows = jax.jit(rows_padded, donate_argnums=(0, 1))
         self._rows_bounded = {}
+        self._rows_gather = {}
 
     def apply_dense(self, data, delta, option: Optional[AddOption] = None):
         hyp, worker_id = _unpack(option)
@@ -149,6 +151,37 @@ class UpdateEngine:
             fn = jax.jit(rows_fn, donate_argnums=(0, 1))
             self._rows_bounded[bounds] = fn
         return fn
+
+    def apply_rows_gather(self, data, row_ids, delta, option,
+                          get_ids, n_col: int):
+        """FUSED row update + row gather in ONE compiled program: apply
+        the delta, then gather ``get_ids`` from the UPDATED table. On a
+        tunneled device each separately dispatched program pays a
+        launch whose cost scales with its buffer arguments — for the
+        sparse dirty-row roundtrip (add, then dirty get) that overhead
+        is the measured bound, and fusing the pair halves it. Both id
+        vectors arrive host-padded (out-of-range drops/zero-fills);
+        the delta pads in-jit like apply_rows."""
+        hyp, worker_id = _unpack(option)
+        fn = self._rows_gather.get(n_col)
+        if fn is None:
+            rule_rows = self.rule.rows
+            pad_cols = self._pad_cols
+            pad_row_count = self._pad_row_count
+
+            def f(data, st, row_ids, delta, hyp, wid, get_ids):
+                delta = pad_row_count(row_ids, pad_cols(data, delta))
+                data, st = rule_rows(data, st, row_ids, delta, hyp,
+                                     wid)
+                values = data.at[get_ids].get(
+                    mode="fill", fill_value=0)[..., :n_col]
+                return data, st, values
+
+            fn = jax.jit(f, donate_argnums=(0, 1))
+            self._rows_gather[n_col] = fn
+        data, self._state, values = fn(data, self._state, row_ids,
+                                       delta, hyp, worker_id, get_ids)
+        return data, values
 
     @property
     def state(self):

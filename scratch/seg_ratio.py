@@ -1,0 +1,81 @@
+"""Probe: two-server same-window ratio, broadcast vs segmented device
+keys, on the real chip. Mirrors bench.run_ps_two_servers' protocol
+(warm outside the window, same block count) but runs all three configs
+back-to-back so launch weather cancels. Also pre-warms the segmented
+programs into the persistent compile cache for the bench."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402  (corpus/config constants)
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    bench._enable_compilation_cache()
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    corpus = os.path.join(tmp, "corpus.txt")
+    bench.SENTENCES = 60_000  # enough kept tokens for 2*G warm + 48 blocks
+    print("[probe] corpus...", file=sys.stderr, flush=True)
+    bench.write_corpus(corpus)
+    dictionary, tokenized = bench._build(corpus)
+    print(f"[probe] vocab={dictionary.size}", file=sys.stderr, flush=True)
+
+    from multiverso_tpu.models.wordembedding import (PSDeviceCorpusTrainer,
+                                                     PSWord2Vec,
+                                                     Word2VecConfig)
+    from multiverso_tpu.runtime.cluster import LocalCluster
+
+    blocks = 48
+
+    def make_body(segment):
+        def body(rank):
+            import multiverso_tpu as mv
+            config = Word2VecConfig(embedding_size=bench.DIM, window=5,
+                                    negative=bench.NEG,
+                                    epochs=bench.EPOCHS,
+                                    batch_size=bench.BATCH, sample=1e-3,
+                                    use_ps=True,
+                                    neg_block=bench.NEG_BLOCK)
+            model = PSWord2Vec(config, dictionary)
+            if rank == 1:
+                for _ in range(2):
+                    mv.current_zoo().barrier()
+                return None
+            trainer = PSDeviceCorpusTrainer(
+                model, tokenized, bench.PS_CENTERS,
+                blocks_per_dispatch=bench.PS_GROUP,
+                segment_keys=segment)
+            trainer.train_epoch(seed=99, max_steps=2 * bench.PS_GROUP)
+            w0 = model.trained_words
+            t0 = time.perf_counter()
+            trainer.train_epoch(seed=0, max_steps=blocks)
+            return model.trained_words - w0, time.perf_counter() - t0
+        return body
+
+    results = {}
+    for name, n, segment in [("single", 1, False),
+                             ("broadcast2", 2, False),
+                             ("segmented2", 2, True),
+                             ("single_b", 1, False)]:
+        cluster = LocalCluster(n, roles=["all", "server"][:n] or ["all"])
+        cluster.timeout = 900.0
+        t0 = time.perf_counter()
+        words, elapsed = cluster.run(make_body(segment))[0]
+        results[name] = words / elapsed
+        print(f"[probe] {name}: {results[name]:,.0f} words/s "
+              f"(phase wall {time.perf_counter() - t0:.1f}s)",
+              file=sys.stderr, flush=True)
+
+    single = (results["single"] + results["single_b"]) / 2
+    print(f"ratio broadcast2/single = {results['broadcast2'] / single:.3f}")
+    print(f"ratio segmented2/single = {results['segmented2'] / single:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -327,6 +327,40 @@ class TestDeviceResidentPath:
         ids2, _ = table.get_dirty_device()  # now clean
         assert ids2.size == 0
 
+    def test_fused_add_get_dirty_matches_composed(self, env):
+        # The -4 fused add+dirty-get must be the exact composition of
+        # add_rows + get_dirty_device (same bookkeeping, one program):
+        # interleaving fused and composed iterations stays consistent.
+        import jax.numpy as jnp
+        table = mv.create_matrix_table(16, 4, is_sparse=True)
+        table.get_dirty_device()  # worker 0 starts clean
+        rows = np.array([2, 9], np.int32)
+        one = jnp.ones((2, 4), jnp.float32)
+        ids, vals = table.add_get_dirty_device(
+            rows, one, option=AddOption(worker_id=1), get_worker=0)
+        np.testing.assert_array_equal(ids, rows)
+        np.testing.assert_array_equal(np.asarray(vals),
+                                      np.ones((2, 4), np.float32))
+        ids2, vals2 = table.add_get_dirty_device(
+            rows, one, option=AddOption(worker_id=1), get_worker=0)
+        np.testing.assert_array_equal(ids2, rows)
+        np.testing.assert_array_equal(np.asarray(vals2),
+                                      2 * np.ones((2, 4), np.float32))
+        # Device-mirror ids (the upload-skipping form) and the cached
+        # dirty device vector produce the same result.
+        ids_m, vals_m = table.add_get_dirty_device(
+            rows, one, option=AddOption(worker_id=1), get_worker=0,
+            row_ids_device=jnp.asarray(rows))
+        np.testing.assert_array_equal(ids_m, rows)
+        np.testing.assert_array_equal(np.asarray(vals_m),
+                                      3 * np.ones((2, 4), np.float32))
+        # The composed pair continues from the fused state seamlessly.
+        table.add_rows(rows, one, option=AddOption(worker_id=1))
+        ids3, vals3 = table.get_dirty_device()
+        np.testing.assert_array_equal(ids3, rows)
+        np.testing.assert_array_equal(np.asarray(vals3),
+                                      4 * np.ones((2, 4), np.float32))
+
     def test_device_keys_rejected_stateful_updater(self, env):
         # Duplicate device ids only SUM correctly under stateless rules;
         # the misconfiguration must raise in the CALLER (the server-side
@@ -351,11 +385,11 @@ class TestDeviceResidentPath:
         with pytest.raises(Exception, match="out of range"):
             table.get_rows(np.array([16], np.int32))
         # Defense in depth: partition itself also rejects non-sentinels
-        # (-3 is the segmented-request marker, so the stray probe uses
-        # -4; a bare -3 with no segment blobs fails its own layout
-        # CHECK).
+        # (-3/-4 are the segmented / fused-dirty markers, so the stray
+        # probe uses -5; a bare -3 with no segment blobs fails its own
+        # layout CHECK).
         with pytest.raises(Exception, match="sentinel"):
-            table.partition([Blob(np.array([-4], np.int32).view(np.uint8))],
+            table.partition([Blob(np.array([-5], np.int32).view(np.uint8))],
                             MsgType.Request_Get)
         with pytest.raises(Exception, match="one id blob per server"):
             table.partition([Blob(np.array([-3], np.int32).view(np.uint8))],
