@@ -599,16 +599,32 @@ def run_ps_two_servers(prebuilt, blocks: int = 48) -> dict:
 
 _TCP_CHILD = r"""
 import os, sys, time, json
+import faulthandler
+# Self-report hangs (a mispaired barrier would otherwise wedge the
+# whole phase silently); budget scales with the rank count since n
+# processes time-share this host's one core, and is cancelled once the
+# timed window ends — teardown must not be hard-killed on a slow run.
+faulthandler.dump_traceback_later(420 + 180 * int(sys.argv[2]),
+                                  exit=True)
 import jax
 jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_compilation_cache_dir',
+                  os.path.join({repo!r}, '.jax_cache'))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 5)
 sys.path.insert(0, {repo!r})
 import numpy as np
 import multiverso_tpu as mv
 from multiverso_tpu.models.wordembedding import (
-    BlockLoader, Dictionary, PSWord2Vec, Word2VecConfig,
-    iter_pair_batches)
+    BlockLoader, Dictionary, PSDeviceCorpusTrainer, PSWord2Vec,
+    TokenizedCorpus, Word2VecConfig, iter_pair_batches)
 rank = int(sys.argv[1]); n = int(sys.argv[2])
-mv.init(['-machine_file=' + {mf!r}, '-rank=' + str(rank)])
+# Mixed-role deployment (the reference's -ps_role split): rank 0 is
+# worker+server and — being co-located with EVERY shard — keeps the
+# zero-copy device pipeline; other ranks are workers whose PS traffic
+# crosses the TCP wire with host batches.
+role = 'all' if rank == 0 else 'worker'
+mv.init(['-machine_file=' + {mf!r}, '-rank=' + str(rank),
+         '-ps_role=' + role])
 d = Dictionary.load({dict_path!r})
 config = Word2VecConfig(embedding_size={dim}, window=5, negative={neg},
                         epochs={epochs}, batch_size={batch},
@@ -625,15 +641,31 @@ def capped(seed, cap):
         yield b
 
 
-model.train_batches(BlockLoader(model.prepared(capped(99, 4))))  # warm
-mv.barrier()
-w0 = model.trained_words
-t0 = time.perf_counter()
-model.train_batches(BlockLoader(model.prepared(
-    capped(rank, {cap}))))
-model._drain_pushes()
-elapsed = time.perf_counter() - t0
-print('TCPRES', json.dumps({{'rank': rank,
+# Barrier protocol — 5 per rank, IDENTICAL on both branches (both
+# train calls end with one internal cluster barrier: train_epoch's
+# epoch-end and train_batches' stream-end): warm-internal, start line,
+# timed-internal, exit line, shutdown.
+if model._device_path:
+    tok = TokenizedCorpus.build(d, {corpus!r})
+    trainer = PSDeviceCorpusTrainer(model, tok, 16384,
+                                    blocks_per_dispatch=4)
+    trainer.train_epoch(seed=99, max_steps=8)   # warm (barrier inside)
+    mv.barrier()  # start line
+    w0 = model.trained_words
+    t0 = time.perf_counter()
+    trainer.train_epoch(seed=0, max_steps={dev_blocks})  # barrier inside
+    elapsed = time.perf_counter() - t0
+else:
+    model.train_batches(BlockLoader(model.prepared(capped(99, 4))))
+    mv.barrier()  # start line
+    w0 = model.trained_words
+    t0 = time.perf_counter()
+    model.train_batches(BlockLoader(model.prepared(
+        capped(rank, {cap}))))   # ends with the stream barrier
+    model._drain_pushes()
+    elapsed = time.perf_counter() - t0
+faulthandler.cancel_dump_traceback_later()
+print('TCPRES', json.dumps({{'rank': rank, 'device': model._device_path,
                              'words': model.trained_words - w0,
                              'elapsed': elapsed}}), flush=True)
 mv.barrier()
@@ -662,7 +694,8 @@ def run_tcp_processes(corpus: str, prebuilt, n: int, tmp: str,
     code = _TCP_CHILD.format(
         repo=os.path.dirname(os.path.abspath(__file__)), mf=mf,
         dict_path=dict_path, corpus=corpus, dim=DIM, neg=NEG,
-        epochs=EPOCHS, batch=BATCH, neg_block=NEG_BLOCK, cap=cap)
+        epochs=EPOCHS, batch=BATCH, neg_block=NEG_BLOCK, cap=cap,
+        dev_blocks=48)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     procs = [subprocess.Popen(
         [sys.executable, "-c", code, str(rank), str(n)],
@@ -681,7 +714,9 @@ def run_tcp_processes(corpus: str, prebuilt, n: int, tmp: str,
     return {"n_processes": n,
             "aggregate_wps": round(words / elapsed, 0),
             "per_rank_wps": [round(r["words"] / r["elapsed"], 0)
-                             for r in results]}
+                             for r in results],
+            "per_rank_device_path": [bool(r.get("device"))
+                                     for r in results]}
 
 
 def topic_separation(emb: np.ndarray, dictionary,

@@ -708,19 +708,21 @@ class PSWord2Vec(Word2Vec):
             # every rank and lets role decide (src/zoo.cpp:29-35). No
             # worker-side step/bucket state to build.
             from ...runtime.zoo import current_zoo
-            self._device_path = current_zoo().net.in_process
+            self._device_path = current_zoo().servers_in_process
             self._num_workers = max(current_zoo().num_workers, 1)
             return
         zoo = self._in_table.zoo
         self._num_workers = max(
             zoo.num_workers if self._num_workers_override is None
             else self._num_workers_override, 1)
-        # When every rank shares the process the whole pull->step->push
-        # loop stays in HBM: device row gathers, device delta scatters —
-        # no host round-trips (critical when the host<->device link is
-        # slow relative to HBM). Cross-process transports serialize, so
-        # they take the host-buffer path.
-        self._device_path = zoo.net.in_process
+        # When every server shard lives in THIS process the whole
+        # pull->step->push loop stays in HBM: device row gathers, device
+        # delta scatters — no host round-trips (critical when the
+        # host<->device link is slow relative to HBM). That covers both
+        # the single-process cluster AND a co-located worker+server rank
+        # in a multi-process -ps_role deployment; workers whose server
+        # traffic crosses the wire take the host-buffer path.
+        self._device_path = zoo.servers_in_process
         # FROZEN row buckets: each batch's unique row count is bounded
         # by what the batch can touch, so padding every request to that
         # one bound gives exactly one compiled gather/step/scatter shape

@@ -224,6 +224,22 @@ class Zoo:
         return -1
 
     @property
+    def servers_in_process(self) -> bool:
+        """True when EVERY server shard lives in this process — the
+        zero-copy device data plane (live ``jax.Array`` blobs in
+        requests and replies) is then valid even when the cluster's
+        transport is a real wire to other ranks. This is the locality
+        rule that lets a co-located worker+server rank keep the fast
+        device pipeline in a multi-process deployment (the reference's
+        -ps_role split runs such mixed topologies; remote workers use
+        the host-batch paths)."""
+        if self.net.in_process:
+            return True
+        return self._num_servers > 0 and all(
+            self.server_rank(s) == self.rank
+            for s in range(self._num_servers))
+
+    @property
     def worker_id(self) -> int:
         return self.rank_to_worker_id(self.rank)
 

@@ -292,10 +292,11 @@ class MatrixWorker(WorkerTable):
         reassembles the exact gather. Costs one extra [k, C] pass per
         additional server, all in HBM."""
         if is_device_array(row_ids):
-            CHECK(self._zoo.net.in_process,
-                  "device-key row gets need in-process servers (a "
-                  "serializing transport flattens the keys to host "
-                  "bytes and the reply shape contract breaks)")
+            CHECK(self._zoo.servers_in_process,
+                  "device-key row gets need the servers in this "
+                  "process (a serializing transport flattens the "
+                  "keys to host bytes and the reply shape contract "
+                  "breaks)")
             CHECK(not self._compress, "device gets bypass wire compression")
             self._dest, self._dest_rows = None, None
             self._device_shards = {}
@@ -343,8 +344,8 @@ class MatrixWorker(WorkerTable):
         protocol: per-server gather cost follows the SEGMENT size, not
         the full id count (ref per-server bucketing contract:
         matrix_table.cpp:234-315)."""
-        CHECK(self._zoo.net.in_process,
-              "segmented device gets need in-process servers")
+        CHECK(self._zoo.servers_in_process,
+              "segmented device gets need the servers in this process")
         CHECK(len(segments) == self._num_server,
               "one segment per server")
         CHECK(all(is_device_array(s) for s in segments),
@@ -363,8 +364,8 @@ class MatrixWorker(WorkerTable):
         each server scatter-adds only its segment (foreign/padding rows
         mask out-of-range and drop). Same stateless-updater contract as
         ``add_rows_async`` device keys."""
-        CHECK(self._zoo.net.in_process,
-              "segmented device adds need in-process servers")
+        CHECK(self._zoo.servers_in_process,
+              "segmented device adds need the servers in this process")
         CHECK(len(segments) == self._num_server
               and len(deltas) == self._num_server,
               "one (segment, delta) pair per server")
@@ -438,8 +439,9 @@ class MatrixWorker(WorkerTable):
             # each scatter-adds only its own rows (foreign rows masked
             # out-of-range and dropped), so the union applies the full
             # delta exactly once.
-            CHECK(self._zoo.net.in_process,
-                  "device-key row adds need in-process servers")
+            CHECK(self._zoo.servers_in_process,
+                  "device-key row adds need the servers in this "
+                  "process")
             CHECK(self._updater_stateless,
                   "device-key row adds need a stateless updater "
                   "(default/sgd): duplicate ids must sum")
@@ -628,9 +630,9 @@ class MatrixWorker(WorkerTable):
         (ref: sparse_matrix_table.cpp:226-258), whose host-buffer reply
         is otherwise bounded by host<->device bandwidth."""
         CHECK(self.is_sparse, "dirty gets are for sparse tables")
-        CHECK(self._zoo.net.in_process,
-              "device dirty gets need in-process servers (the reply "
-              "payload is a live device array)")
+        CHECK(self._zoo.servers_in_process,
+              "device dirty gets need the servers in this process "
+              "(the reply payload is a live device array)")
         self._dest, self._dest_rows = None, None
         self._device_shards = {}
         self._device_sum = False
@@ -673,10 +675,10 @@ class MatrixWorker(WorkerTable):
         the dirty bookkeeping, which is a host bitmap). Stateless
         updaters only, as with device-key adds."""
         CHECK(self.is_sparse, "fused add+dirty-get is for sparse tables")
-        CHECK(self._num_server == 1 and self._zoo.net.in_process,
-              "fused add+dirty-get is a single-server in-process "
-              "extension (multi-server callers compose add_rows + "
-              "get_dirty_device)")
+        CHECK(self._num_server == 1 and self._zoo.servers_in_process,
+              "fused add+dirty-get is a single-server extension with "
+              "the server in this process (multi-server callers "
+              "compose add_rows + get_dirty_device)")
         CHECK(not bool(get_flag("sync", False)),
               "fused add+dirty-get is async-only: the embedded add "
               "would bypass the BSP vector clocks")
@@ -703,6 +705,12 @@ class MatrixWorker(WorkerTable):
         if row_ids_device is not None:
             CHECK(is_device_array(row_ids_device),
                   "row_ids_device must be a device array")
+            # A mirror that disagrees with the host ids would mark one
+            # row set dirty and scatter the delta at ANOTHER (silent
+            # corruption), or crash inside the server actor (hang).
+            CHECK(tuple(row_ids_device.shape) == (row_ids.size,)
+                  and np.dtype(row_ids_device.dtype) == np.int32,
+                  "row_ids_device must mirror row_ids ([k] int32)")
             CHECK(self._updater_stateless,
                   "device-id fused adds need a stateless updater")
             blobs.append(Blob(row_ids_device))

@@ -14,6 +14,7 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -283,6 +284,61 @@ os._exit(1)  # crash without goodbye frames
             p.kill()
         out0, err0 = procs[0].communicate()
     assert "ABORTED_OK" in out0, out0 + err0[-1000:]
+
+
+def test_colocated_device_path_over_tcp(tmp_path):
+    # Locality rule (r5): a worker co-located with EVERY server shard
+    # keeps the zero-copy device pipeline even on a TCP cluster, while
+    # the remote worker crosses the wire with host batches — the
+    # reference's -ps_role mixed deployment (src/zoo.cpp:29-35), with
+    # the data plane picked per rank by locality.
+    mf, _ = write_machine_file(tmp_path, 2)
+    corpus = tmp_path / "corpus.txt"
+    rng = np.random.default_rng(0)
+    topics = [[f"a{i}" for i in range(8)], [f"b{i}" for i in range(8)]]
+    with open(corpus, "w") as f:
+        for _ in range(200):
+            topic = topics[rng.integers(0, 2)]
+            f.write(" ".join(rng.choice(topic, size=10)) + "\n")
+    common = f"""
+from multiverso_tpu.models.wordembedding import (
+    BlockLoader, Dictionary, PSDeviceCorpusTrainer, PSWord2Vec,
+    TokenizedCorpus, Word2VecConfig, iter_pair_batches)
+corpus = {str(corpus)!r}
+d = Dictionary.build(corpus, min_count=1)
+role = "all" if rank == 0 else "worker"
+mv.init(["-machine_file=" + {mf!r}, "-rank=" + str(rank),
+         "-ps_role=" + role])
+config = Word2VecConfig(embedding_size=8, window=3, epochs=2,
+                        init_learning_rate=0.02, batch_size=256,
+                        sample=0, use_ps=True)
+model = PSWord2Vec(config, d)
+"""
+    body0 = common + """
+assert model._device_path, "co-located rank must keep the device path"
+tok = TokenizedCorpus.build(d, corpus)
+trainer = PSDeviceCorpusTrainer(model, tok, centers_per_step=64)
+loss, pairs = trainer.train_epoch(seed=0)  # ends with one barrier
+assert pairs > 0 and loss == loss
+mv.barrier()
+mv.shutdown()
+print("RANK0_DEVICE_OK")
+"""
+    body1 = common + """
+assert not model._device_path, "remote worker must take host batches"
+loss_sum = 0.0
+for b in iter_pair_batches(d, corpus, batch_size=256, window=3,
+                           subsample=0, seed=0):
+    loss_sum += model.train_batch(b)
+model._drain_pushes()
+mv.barrier()  # pairs rank 0's epoch-end barrier
+mv.barrier()
+mv.shutdown()
+print("RANK1_HOSTBATCH_OK")
+"""
+    outs = run_cluster([body0, body1])
+    assert "RANK0_DEVICE_OK" in outs[0], outs
+    assert "RANK1_HOSTBATCH_OK" in outs[1], outs
 
 
 def test_init_distributed_two_processes(tmp_path):
