@@ -1241,6 +1241,8 @@ class _Result:
                   f"~{est}s estimate exceeds {WALL_BUDGET_SEC:.0f}s "
                   "budget", file=sys.stderr, flush=True)
             self.doc["detail"]["wall_budget"]["skipped"].append(name)
+            self.emit()  # the skip record must not wait for a later
+            # phase to land on stdout
             return None
         try:
             return _phase(name, fn, *args, **kw)
