@@ -814,6 +814,73 @@ def cpp_baseline(corpus: str, tmp: str, dictionary) -> dict:
     return stats
 
 
+def _dispatch_rtt_ms(iters: int) -> float:
+    """Per-call dispatch + completion round trip for a tiny jitted op
+    (scalar readback per call — the async pipeline would otherwise
+    hide it). NOTE: jax.block_until_ready is not reliable on the
+    tunneled platform; the float() readback is the sync."""
+    import jax
+    import jax.numpy as jnp
+    tiny = jax.jit(lambda x: x + 1.0)
+    s = tiny(jnp.float32(0))
+    float(s)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s = tiny(s)
+        float(s)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _launch_overhead_samples(blocks: int, per_block: int) -> list:
+    """Per-program launch cost: chained (no readback) executions still
+    serialize device-side; each sample is one block's mean."""
+    import jax
+    import jax.numpy as jnp
+    tiny = jax.jit(lambda x: x + 1.0)
+    s = tiny(jnp.float32(0))
+    float(s)
+    samples = []
+    for _ in range(blocks):
+        t0 = time.perf_counter()
+        for _ in range(per_block):
+            s = tiny(s)
+        float(s)
+        samples.append((time.perf_counter() - t0) / per_block * 1e3)
+    return samples
+
+
+def _tunnel_rates_mbps(n_floats: int) -> tuple:
+    """(upload, download) MB/s through the tunnel: warmed path, fresh
+    bytes allocated OUTSIDE the timed window."""
+    import jax.numpy as jnp
+    probe = np.ones(n_floats, np.float32)
+    float(jnp.asarray(probe)[0])  # warm the transfer path
+    probe2 = probe * 2.0
+    t0 = time.perf_counter()
+    dev = jnp.asarray(probe2)
+    float(dev[0])
+    up = probe.nbytes / (time.perf_counter() - t0) / 1e6
+    t0 = time.perf_counter()
+    np.asarray(dev)
+    down = probe.nbytes / (time.perf_counter() - t0) / 1e6
+    return up, down
+
+
+def weather_probe() -> dict:
+    """~10s platform-state snapshot taken before any TIMED phase: the
+    tunneled chip's dispatch RTT / program-launch overhead swing 5-50x
+    across hours, and a words/s number without the weather it was
+    measured in is uninterpretable. Recorded first so even a truncated
+    run carries its context (the matrix phase re-measures at the end
+    with the same helpers)."""
+    rtt_ms = _dispatch_rtt_ms(5)
+    launch = _launch_overhead_samples(2, 20)
+    up_mbps, _ = _tunnel_rates_mbps(2 << 20)  # 8 MB
+    return {"dispatch_roundtrip_ms": round(rtt_ms, 1),
+            "program_launch_ms": round(float(np.median(launch)), 3),
+            "tunnel_upload_mbps": round(up_mbps, 1)}
+
+
 def utilization(pairs_per_sec: float, centers_per_sec: float,
                 window: int = 5) -> dict:
     """Achieved FLOP/s and HBM bytes/s for the BANDED SGNS step vs chip
@@ -921,46 +988,16 @@ def matrix_bandwidth() -> dict:
     float(acc)
     get_gbps = nbytes / ((time.perf_counter() - start) / iters) / 1e9
 
-    # Tunnel characterization: the dirty-row sparse Get fills a HOST
-    # buffer (reference API semantics), so on a tunneled device it is
-    # capped by host<->device bandwidth, not by the table stack. Measure
-    # and report both directions so the sparse number is interpretable.
-    probe = np.ones(4 << 20, np.float32)  # 16 MB
-    float(jnp.asarray(probe)[0])  # warm the transfer path
-    probe2 = probe * 2.0  # fresh bytes, allocated OUTSIDE the window
-    t0 = time.perf_counter()
-    dev_probe = jnp.asarray(probe2)
-    float(dev_probe[0])
-    up_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
-    t0 = time.perf_counter()
-    np.asarray(dev_probe)
-    down_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
-    # Per-call dispatch floor: how long one tiny jitted op takes to
-    # dispatch AND complete (scalar readback per call). On a tunneled
-    # device this floor (not compute) often bounds words/s — report it
-    # so rates are readable.
-    tiny = jax.jit(lambda x: x + 1.0)
-    s0 = tiny(jnp.float32(0))
-    float(s0)
-    t0 = time.perf_counter()
-    for _ in range(20):
-        s0 = tiny(s0)
-        float(s0)  # force EACH call: the async pipeline would
-        # otherwise hide the per-call roundtrip
-    dispatch_ms = (time.perf_counter() - t0) / 20 * 1e3
-    # Per-PROGRAM launch floor: chained (no readback) executions still
-    # serialize device-side at ~3-15ms each on the tunneled platform —
-    # the hard floor under any eager add/get alternation (e.g. the
-    # sparse dirty roundtrip = 2 programs per iteration). Sampled as a
-    # small DISTRIBUTION: the overhead is weather-volatile (5-50x over
-    # hours) and a single mean hides that.
-    launch_samples = []
-    for _ in range(4):
-        t0 = time.perf_counter()
-        for _ in range(20):
-            s0 = tiny(s0)
-        float(s0)
-        launch_samples.append((time.perf_counter() - t0) / 20 * 1e3)
+    # Tunnel characterization (shared helpers with the start-of-run
+    # weather_probe, so the two snapshots stay comparable): transfer
+    # rates both directions — the host-buffer dirty Get is capped by
+    # them, not by the table stack; the per-call dispatch floor; and
+    # the per-PROGRAM launch floor sampled as a small DISTRIBUTION
+    # (the overhead is weather-volatile 5-50x over hours and a single
+    # mean hides that).
+    up_mbps, down_mbps = _tunnel_rates_mbps(4 << 20)  # 16 MB
+    dispatch_ms = _dispatch_rtt_ms(20)
+    launch_samples = _launch_overhead_samples(4, 20)
     launch_ms = float(np.median(launch_samples))
 
     # Sparse dirty-row path (ref: test_matrix_perf.cpp sparse variants):
@@ -1187,7 +1224,7 @@ _BENCH_T0 = time.monotonic()
 # Conservative worst-case phase costs (sec) on this platform, from the r3/r4
 # driver tails — used only for the skip decision, never for timing.
 _PHASE_EST = {
-    "write_corpus": 8, "build_dictionary": 25,
+    "write_corpus": 8, "build_dictionary": 25, "weather_probe": 30,
     "cpp_baseline": 340, "cpu_baseline": 430,
     "local_train": 100, "ps_train": 110,
     "quality_local": 190, "quality_ps": 180,
@@ -1368,6 +1405,9 @@ def main() -> None:
         "corpus": "synthetic 2-topic banded Zipf "
                   "(no egress: enwik9 unavailable)"})
     result.emit()  # a complete (if empty) line exists from second zero
+    weather = result.run("weather_probe", weather_probe)
+    if weather:
+        result.merge(weather_at_start=weather)
     _phase("write_corpus", write_corpus, corpus)
     prebuilt = _phase("build_dictionary", _build, corpus)
     result.doc["detail"]["setup"]["vocab_actual"] = prebuilt[0].size

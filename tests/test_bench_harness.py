@@ -76,15 +76,16 @@ class TestResultEmission:
         monkeypatch.setattr(os, "_exit", exits.append)
         r = bench._Result()
         r.merge(gamma=3)
-        saved = signal.getsignal(signal.SIGTERM)
+        saved_term = signal.getsignal(signal.SIGTERM)
+        saved_int = signal.getsignal(signal.SIGINT)
         try:
             bench._install_kill_emitter(r)
             handler = signal.getsignal(signal.SIGTERM)
             capsys.readouterr()
             handler(signal.SIGTERM, None)
         finally:
-            signal.signal(signal.SIGTERM, saved)
-            signal.signal(signal.SIGINT, signal.default_int_handler)
+            signal.signal(signal.SIGTERM, saved_term)
+            signal.signal(signal.SIGINT, saved_int)
         doc = _last_json(capsys)
         assert doc["detail"]["gamma"] == 3
         assert doc["detail"]["wall_budget"]["interrupted"] == "SIGTERM"
@@ -102,15 +103,16 @@ class TestResultEmission:
         monkeypatch.setattr(
             r, "emit",
             lambda: (_ for _ in ()).throw(RuntimeError("torn")))
-        saved = signal.getsignal(signal.SIGTERM)
+        saved_term = signal.getsignal(signal.SIGTERM)
+        saved_int = signal.getsignal(signal.SIGINT)
         try:
             bench._install_kill_emitter(r)
             handler = signal.getsignal(signal.SIGTERM)
             capsys.readouterr()
             handler(signal.SIGTERM, None)
         finally:
-            signal.signal(signal.SIGTERM, saved)
-            signal.signal(signal.SIGINT, signal.default_int_handler)
+            signal.signal(signal.SIGTERM, saved_term)
+            signal.signal(signal.SIGINT, saved_int)
         doc = _last_json(capsys)
         assert doc["detail"]["delta"] == 4  # the pre-serialized line
 
