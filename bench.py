@@ -677,19 +677,24 @@ def run_tcp_processes(corpus: str, prebuilt, n: int, tmp: str,
                       cap: int = 24) -> dict:
     """Cross-process PS over the TCP transport (VERDICT r3 #4): n OS
     processes on a localhost machine-file mesh (the reference's ZMQ
-    deployment, zmq_net.h:20-61), each training the host-batch PS path
-    on the CPU backend (this host exposes one TPU chip; the cross-
-    process story is the transport's, not the chip's). NOTE this box
-    has ONE CPU core — n processes time-share it, so aggregate words/s
-    measures transport overhead, not scaling headroom."""
+    deployment, zmq_net.h:20-61): rank 0 is worker+server (keeping the
+    device pipeline under the co-location rule), other ranks are
+    workers on the CPU backend. NOTE this box has ONE CPU core — n
+    processes time-share it, so aggregate words/s measures transport
+    overhead, not scaling headroom."""
+    from multiverso_tpu.util.net_util import free_listen_port
     dictionary, _ = prebuilt
     dict_path = os.path.join(tmp, "bench_dict.txt")
     if not os.path.exists(dict_path):
         dictionary.store(dict_path)
     mf = os.path.join(tmp, f"bench_mf_{n}.txt")
     with open(mf, "w") as f:
-        ports = [19900 + 10 * n + i for i in range(n)]
-        for p in ports:
+        # Fresh probed ports per run (free_listen_port scans below the
+        # ephemeral range — deliberately NOT bind(0)-assigned, which
+        # could be stolen before the child binds): a static port list
+        # breaks the whole phase if any earlier crashed run left an
+        # orphan holding one.
+        for p in [free_listen_port() for _ in range(n)]:
             f.write(f"127.0.0.1:{p}\n")
     code = _TCP_CHILD.format(
         repo=os.path.dirname(os.path.abspath(__file__)), mf=mf,
@@ -702,13 +707,22 @@ def run_tcp_processes(corpus: str, prebuilt, n: int, tmp: str,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env) for rank in range(n)]
     results = []
-    for p in procs:
-        out, err = p.communicate(timeout=1200)
-        if p.returncode:
-            raise RuntimeError(f"tcp child failed: {err[-300:]}")
-        for line in out.splitlines():
-            if line.startswith("TCPRES "):
-                results.append(json.loads(line[7:]))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=1200)
+            if p.returncode:
+                raise RuntimeError(f"tcp child failed: {err[-300:]}")
+            for line in out.splitlines():
+                if line.startswith("TCPRES "):
+                    results.append(json.loads(line[7:]))
+    finally:
+        # A raise above (timeout, failed child) must not ORPHAN the
+        # sibling ranks: they would keep time-sharing this host's one
+        # core and holding their mesh ports for the rest of the bench.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     words = sum(r["words"] for r in results)
     elapsed = max(r["elapsed"] for r in results)
     return {"n_processes": n,
