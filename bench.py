@@ -895,6 +895,51 @@ def weather_probe() -> dict:
             "tunnel_upload_mbps": round(up_mbps, 1)}
 
 
+def run_wire_codec() -> dict:
+    """Pure-host codec phase: compression ratio + encode/decode GB/s on
+    a canned power-law sparse gradient (the PS push/pull and ma-mode
+    allreduce wire shape), against the REMOVED float64-pair encoding
+    (16 B/surviving pair + an 8-byte size record) as the baseline."""
+    from multiverso_tpu.util import wire_codec as wc
+    rng = np.random.default_rng(7)
+    n = 1 << 20  # 4 MB of fp32 — a realistic embedding-push blob
+    nnz = n // 20  # 5% density, power-law magnitudes
+    blob = np.zeros(n, np.float32)
+    idx = np.sort(rng.choice(n, nnz, replace=False))
+    blob[idx] = ((rng.pareto(2.0, nnz) + 0.1)
+                 * np.sign(rng.standard_normal(nnz))).astype(np.float32)
+    old_pair_bytes = 16 * nnz + 8  # float64 pairs + int64 size record
+
+    out = {"blob_elements": n, "density": nnz / n,
+           "old_float64_pair_bytes": old_pair_bytes}
+    for label, lossy in (("lossless", False), ("lossy", True)):
+        frame, _ = wc.encode_blob(blob, lossy=lossy)
+        decoded = wc.decode_blob(frame)
+        if not lossy:
+            np.testing.assert_array_equal(decoded, blob)
+        iters = 8
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            wc.encode_blob(blob, lossy=lossy)
+        enc_s = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            wc.decode_blob(frame)
+        dec_s = (time.perf_counter() - t0) / iters
+        out[label] = {
+            "tier": wc.tier_name(wc.peek_tier(frame)),
+            "wire_bytes": len(frame),
+            "ratio_vs_float64_pairs": round(old_pair_bytes / len(frame), 3),
+            "ratio_vs_raw": round(blob.nbytes / len(frame), 3),
+            "encode_gbps": round(blob.nbytes / enc_s / 1e9, 3),
+            "decode_gbps": round(blob.nbytes / dec_s / 1e9, 3),
+        }
+        if lossy:
+            out[label]["max_abs_err"] = \
+                round(float(np.abs(decoded - blob).max()), 6)
+    return out
+
+
 def utilization(pairs_per_sec: float, centers_per_sec: float,
                 window: int = 5) -> dict:
     """Achieved FLOP/s and HBM bytes/s for the BANDED SGNS step vs chip
@@ -1050,7 +1095,8 @@ def matrix_bandwidth() -> dict:
     # iteration instead of two — and the caller keeps a device mirror
     # of its row ids (the per-call id upload otherwise rides the
     # ~35 MB/s tunnel).
-    dev_rows = jnp.asarray(rows)
+    from multiverso_tpu.updater.engine import pad_ids
+    dev_rows = jnp.asarray(pad_ids(rows, num_row))  # bucket-padded mirror
     _, f_vals = sparse.add_get_dirty_device(rows, dev_delta,
                                             option=opt, get_worker=0,
                                             row_ids_device=dev_rows)
@@ -1246,6 +1292,7 @@ _PHASE_EST = {
     "ps_two_workers": 60, "ps_two_servers": 95,
     "tcp_one_process": 65, "tcp_two_process": 110,
     "matrix_bandwidth": 60, "local_retime": 60,
+    "wire_codec": 15,
 }
 
 
@@ -1422,6 +1469,9 @@ def main() -> None:
     weather = result.run("weather_probe", weather_probe)
     if weather:
         result.merge(weather_at_start=weather)
+    codec = result.run("wire_codec", run_wire_codec)
+    if codec:
+        result.merge(wire_codec=codec)
     _phase("write_corpus", write_corpus, corpus)
     prebuilt = _phase("build_dictionary", _build, corpus)
     result.doc["detail"]["setup"]["vocab_actual"] = prebuilt[0].size

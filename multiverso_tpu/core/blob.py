@@ -91,6 +91,16 @@ class Blob:
             return arr
         return arr.reshape(-1).view(dtype)
 
+    def wire_bytes(self) -> np.ndarray:
+        """Flat uint8 view of the payload for wire serialization
+        (materializes device arrays — this IS the host boundary). The
+        single place the byte layout of an outgoing blob is defined:
+        the TCP framer and the wire-codec filter both read through it,
+        so a filtered and an unfiltered serialization path cannot
+        disagree on what the raw bytes are."""
+        arr = np.asarray(self._data)
+        return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
     def __getitem__(self, i: int) -> int:
         return int(self._host().reshape(-1).view(np.uint8)[i])
 

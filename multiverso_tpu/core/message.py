@@ -23,8 +23,13 @@ class MsgType(enum.IntEnum):
     Default = 0
     Request_Get = 1
     Request_Add = 2
+    # Coalesced Add: several pending Adds to the same server ride ONE
+    # wire message (extension — the reference sends one message per
+    # shard; value chosen inside the server-bound request band).
+    Request_BatchAdd = 3
     Reply_Get = -1
     Reply_Add = -2
+    Reply_BatchAdd = -3
     Server_Finish_Train = 31
     Control_Barrier = 33
     Control_Reply_Barrier = -33
@@ -131,6 +136,62 @@ def take_error(msg: "Message") -> Optional[str]:
     if msg.data:
         return bytes(msg.data[0].as_array(np.uint8)).decode(errors="replace")
     return "remote table operation failed"
+
+
+# Header slot 6 marks a codec-encoded payload (see util/wire_codec.py):
+# the communicator's filter stage sets it on encode and the receive path
+# decodes before routing, so frames stay self-describing on the wire.
+CODEC_SLOT = 6
+
+
+def is_wire_encoded(msg: "Message") -> bool:
+    return bool(msg.header[CODEC_SLOT])
+
+
+# -- Add coalescing (Request_BatchAdd / Reply_BatchAdd) --
+#
+# Batch request layout: blob 0 is an int32 descriptor
+#   [n_sub, table_id_0, msg_id_0, n_blobs_0, ..., table_id_{n-1}, ...]
+# followed by every sub-message's blobs in order. Batch reply layout:
+# blob 0 is int32 [n_sub, table_id_0, msg_id_0, err_0, ...] followed by
+# one utf-8 error-text blob per err_i != 0 (in sub order).
+
+def pack_add_batch(subs: List["Message"]) -> "Message":
+    """Coalesce several Request_Add shard messages (same src, same dst)
+    into one Request_BatchAdd wire message."""
+    first = subs[0]
+    batch = Message(src=first.src, dst=first.dst,
+                    msg_type=MsgType.Request_BatchAdd)
+    desc = [len(subs)]
+    for sub in subs:
+        desc.extend((sub.table_id, sub.msg_id, len(sub.data)))
+    batch.push(Blob(np.asarray(desc, dtype=np.int32)))
+    for sub in subs:
+        batch.data.extend(sub.data)
+    return batch
+
+
+def unpack_add_batch(batch: "Message") -> List["Message"]:
+    """Reverse ``pack_add_batch`` into per-table Request_Add messages."""
+    desc = batch.data[0].as_array(np.int32)
+    n = int(desc[0])
+    subs: List[Message] = []
+    off = 1
+    blob_off = 1
+    for _ in range(n):
+        table_id, msg_id, n_blobs = (int(v) for v in desc[off:off + 3])
+        off += 3
+        sub = Message(src=batch.src, dst=batch.dst,
+                      msg_type=MsgType.Request_Add,
+                      table_id=table_id, msg_id=msg_id)
+        sub.data = list(batch.data[blob_off:blob_off + n_blobs])
+        blob_off += n_blobs
+        subs.append(sub)
+    if blob_off != len(batch.data):
+        raise ValueError(
+            f"batch add: descriptor claims {blob_off - 1} blobs, "
+            f"message carries {len(batch.data) - 1}")
+    return subs
 
 
 def is_server_bound(msg_type: int) -> bool:

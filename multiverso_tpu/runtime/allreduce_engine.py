@@ -27,10 +27,18 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..core.blob import Blob
-from ..core.message import Message, MsgType
+from ..core.message import Message, MsgType, is_wire_encoded
+from ..util.configure import get_flag
+from ..util.wire_codec import (CODEC_SLOT, decode_blob, encode_blob,
+                               worth_encoding)
 from .net import NetInterface
 
 _SMALL_BYTES = 4096  # allgather-based path threshold (ref: engine.cpp:33)
+
+#: Segment payloads at least this large run through the wire codec on
+#: non-in-process transports (lossless tiers; sparse model-average
+#: deltas shrink, dense ones ride RAW with only the header overhead).
+_CODEC_MIN_BYTES = 4096
 
 
 class AllreduceEngine:
@@ -39,12 +47,28 @@ class AllreduceEngine:
         self.rank = net.rank
         self.size = net.size
         self._stash = {}  # (src, tag) -> blob, for early-arriving rounds
+        # Frames are self-describing (CODEC_SLOT marks an encoded
+        # payload), so decode needs no negotiation; in ma mode every
+        # rank runs this same engine. In-process transports move object
+        # references — encoding there only burns CPU.
+        self._codec = (not net.in_process
+                       and bool(get_flag("wire_codec")))
 
     # -- raw paired exchange over the message transport --
     def _send(self, dst: int, payload: np.ndarray, tag: int) -> None:
         msg = Message(src=self.rank, dst=dst, msg_type=MsgType.Default,
                       msg_id=tag)
-        msg.push(Blob(np.ascontiguousarray(payload)))
+        payload = np.ascontiguousarray(payload)
+        # worth_encoding gates on density too: dense model-average
+        # segments (the common ma workload) skip the frame-copy round
+        # trip a RAW frame would cost.
+        if self._codec and payload.nbytes >= _CODEC_MIN_BYTES \
+                and worth_encoding(payload):
+            frame, _ = encode_blob(payload)  # lossless tiers only
+            msg.push(Blob(np.frombuffer(frame, np.uint8)))
+            msg.header[CODEC_SLOT] = 1
+        else:
+            msg.push(Blob(payload))
         self._net.send(msg)
 
     def _recv(self, src: int, tag: int, dtype) -> np.ndarray:
@@ -55,7 +79,10 @@ class AllreduceEngine:
             msg = self._net.recv(timeout=120)
             if msg is None:
                 raise RuntimeError("allreduce engine: transport closed")
-            self._stash[(msg.src, msg.msg_id)] = msg.data[0]
+            blob = msg.data[0]
+            if is_wire_encoded(msg):
+                blob = Blob(decode_blob(np.asarray(blob.data)))
+            self._stash[(msg.src, msg.msg_id)] = blob
         return self._stash.pop(key).as_array(dtype)
 
     def _exchange(self, peer: int, payload: np.ndarray,

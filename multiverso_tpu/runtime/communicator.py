@@ -17,7 +17,11 @@ import threading
 from typing import Optional
 
 from ..core.message import (Message, is_controller_bound, is_server_bound,
-                            is_worker_bound)
+                            is_wire_encoded, is_worker_bound)
+from ..util import log
+from ..util.configure import get_flag
+from ..util.wire_codec import (CAP_WIRE_CODEC, decode_message,
+                               encode_message)
 from . import actor as actors
 from .actor import Actor
 
@@ -27,6 +31,13 @@ class Communicator(Actor):
         super().__init__(actors.COMMUNICATOR, zoo)
         self._net = zoo.net
         self._recv_thread: Optional[threading.Thread] = None
+        # Filter stage: encode only over a real wire (in-process blobs
+        # move by reference — filtering would burn CPU and flatten
+        # device payloads to host bytes for nothing), only when this
+        # rank runs with the codec, and — checked per message — only
+        # toward peers that ADVERTISED it during registration.
+        self._codec = (not self._net.in_process
+                       and bool(get_flag("wire_codec")))
 
     def start(self) -> None:
         super().start()
@@ -52,9 +63,15 @@ class Communicator(Actor):
         self._net.release_recv_owner()
 
     # Outbound path: actor mailbox -> wire (or loop back locally); every
-    # message type goes through the same route-or-send dispatch.
+    # message type goes through the same route-or-send dispatch. The
+    # codec filter stage runs here — per message, gated on the PEER's
+    # advertised capability so a passthrough peer keeps getting plain
+    # frames (mixed-version clusters stay correct, merely uncompressed).
     def _dispatch(self, msg: Message) -> None:
         if msg.dst != self._zoo.rank:
+            if self._codec and \
+                    self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
+                encode_message(msg)
             self._net.send(msg)
         else:
             self._local_forward(msg)
@@ -62,10 +79,30 @@ class Communicator(Actor):
     # Inbound path: wire -> local actor mailboxes
     # (ref: src/communicator.cpp:77-91).
     def _recv_main(self) -> None:
+        codec_in = bool(get_flag("wire_codec"))
         while True:
             msg = self._net.recv()
             if msg is None:
                 break
+            if is_wire_encoded(msg):
+                if not codec_in:
+                    # A peer encoded toward a rank that never advertised
+                    # the codec: negotiation bug. Fail loudly instead of
+                    # routing garbage bytes into table logic.
+                    log.error("rank %d: codec frame received but "
+                              "-wire_codec is off; dropping message %r",
+                              self._zoo.rank, msg)
+                    continue
+                try:
+                    decode_message(msg)
+                except Exception:  # noqa: BLE001 - poison frame must
+                    # not kill the recv thread (every later message
+                    # would silently vanish)
+                    log.error("rank %d: undecodable codec frame %r",
+                              self._zoo.rank, msg)
+                    import traceback
+                    traceback.print_exc()
+                    continue
             self._safe_dispatch(msg)
 
     # Routing rule (ref: src/communicator.cpp:13-29).

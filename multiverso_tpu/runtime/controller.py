@@ -48,10 +48,15 @@ class Controller(Actor):
         # Assign dense worker/server ids in rank order
         # (ref: src/controller.cpp:46-66).
         nodes = [Node(rank=r) for r in range(self._zoo.net_size)]
+        # Wire-capability word per rank (register blob int 2; absent on
+        # pre-codec peers, which therefore stay at 0 = passthrough).
+        caps = np.zeros(self._zoo.net_size, dtype=np.int32)
         for request in self._register_waiting:
-            rank, role = (int(x) for x in
-                          request.data[0].as_array(np.int32)[:2])
+            reg = request.data[0].as_array(np.int32)
+            rank, role = int(reg[0]), int(reg[1])
             nodes[rank].role = role
+            if reg.size >= 3:
+                caps[rank] = int(reg[2])
         num_workers = num_servers = 0
         for node in nodes:
             if is_worker(node.role):
@@ -68,5 +73,6 @@ class Controller(Actor):
             reply = request.create_reply_message()
             reply.push(Blob(table.copy()))
             reply.push(Blob(counts.copy()))
+            reply.push(Blob(caps.copy()))
             self.send_to(actors.COMMUNICATOR, reply)
         self._register_waiting = []

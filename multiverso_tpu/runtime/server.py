@@ -13,9 +13,13 @@ here is host-side control only, with the arithmetic jit-dispatched.
 from __future__ import annotations
 
 import collections
-from typing import Deque, List
+import threading
+from typing import Deque, List, Optional
 
-from ..core.message import Message, MsgType, mark_error
+import numpy as np
+
+from ..core.blob import Blob
+from ..core.message import Message, MsgType, mark_error, unpack_add_batch
 from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
@@ -34,11 +38,22 @@ _INF = float("inf")
 
 
 class Server(Actor):
+    #: Process-wide: table logic dispatches jitted programs over the
+    #: process's (shared) device mesh, and TWO server actor threads —
+    #: virtual ranks on a LocalFabric — interleaving multi-device
+    #: executions deadlock inside XLA's CPU runtime (observed: both
+    #: threads parked in pxla __call__ forever). One server per process
+    #: (the real deployment) never contends; RLock because the sync
+    #: server's drain paths re-enter through Server._process_*.
+    _table_lock = threading.RLock()
+
     def __init__(self, zoo) -> None:
         super().__init__(actors.SERVER, zoo)
         self._store: List = []  # registered ServerTables, indexed by table id
         self.register_handler(MsgType.Request_Get, self._process_get)
         self.register_handler(MsgType.Request_Add, self._process_add)
+        self.register_handler(MsgType.Request_BatchAdd,
+                              self._process_batch_add)
 
     @staticmethod
     def get_server(zoo) -> "Server":
@@ -64,7 +79,9 @@ class Server(Actor):
             # actor loop only logs; without this, every server-side CHECK
             # degrades to silent garbage at the caller).
             try:
-                reply.data = self._store[msg.table_id].process_get(msg.data)
+                with self._table_lock:
+                    reply.data = \
+                        self._store[msg.table_id].process_get(msg.data)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
                 raise
@@ -76,11 +93,78 @@ class Server(Actor):
         with monitor("SERVER_PROCESS_ADD"):
             reply = msg.create_reply_message()
             try:
-                self._store[msg.table_id].process_add(msg.data)
+                with self._table_lock:
+                    self._store[msg.table_id].process_add(msg.data)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
                 raise
             finally:
+                self.send_to(actors.COMMUNICATOR, reply)
+
+    def _process_batch_add(self, msg: Message) -> None:
+        """Coalesced adds: apply every sub-add, ack them all in ONE
+        Reply_BatchAdd (descriptor [n, (table_id, msg_id, err)...] +
+        one utf-8 text blob per failed sub). A sub failure must not
+        stop the siblings: each waiter still gets its notify, failed
+        ones with the error recorded so the caller's wait() raises.
+        The reply goes out in EVERY path — a swallowed reply would
+        strand every sub-add's waiter forever (same invariant as
+        _process_get/_process_add above) — so a batch whose payload
+        blobs fail to unpack still acks each sub the descriptor names,
+        all marked failed."""
+        with monitor("SERVER_PROCESS_BATCH_ADD"):
+            reply = msg.create_reply_message()
+            desc: List[int] = [0]
+            err_blobs: List[Blob] = []
+
+            def record(table_id: int, msg_id: int,
+                       exc: Optional[BaseException]) -> None:
+                desc.extend((table_id, msg_id, 0 if exc is None else 1))
+                desc[0] += 1
+                if exc is not None:
+                    text = f"{type(exc).__name__}: {exc}" \
+                        .encode(errors="replace")
+                    err_blobs.append(
+                        Blob(np.frombuffer(text, np.uint8).copy()))
+
+            try:
+                try:
+                    subs = unpack_add_batch(msg)
+                except Exception as exc:  # noqa: BLE001 - malformed
+                    # batch: the descriptor (blob 0) usually still
+                    # parses even when the payload blobs are short —
+                    # ack every sub it names as failed so no waiter
+                    # hangs; a garbage descriptor leaves only the
+                    # error-marked empty reply (worker logs it).
+                    log.error("server: batch add unpack failed")
+                    import traceback
+                    traceback.print_exc()
+                    try:
+                        raw = msg.data[0].as_array(np.int32)
+                        for i in range(int(raw[0])):
+                            record(int(raw[1 + 3 * i]),
+                                   int(raw[2 + 3 * i]), exc)
+                    except Exception:  # noqa: BLE001
+                        mark_error(reply, exc)
+                        return
+                    return
+                for sub in subs:
+                    try:
+                        with self._table_lock:
+                            self._store[sub.table_id].process_add(
+                                sub.data)
+                        record(sub.table_id, sub.msg_id, None)
+                    except Exception as exc:  # noqa: BLE001 - per-sub
+                        # failure travels back in the batch ack
+                        record(sub.table_id, sub.msg_id, exc)
+                        log.error("server: batched add failed "
+                                  "(error travels in the batch ack)")
+                        import traceback
+                        traceback.print_exc()
+            finally:
+                if not reply.data:  # mark_error path already has payload
+                    reply.push(Blob(np.asarray(desc, dtype=np.int32)))
+                    reply.data.extend(err_blobs)
                 self.send_to(actors.COMMUNICATOR, reply)
 
 
@@ -158,6 +242,15 @@ class SyncServer(Server):
             if self._add_clocks.update(worker):
                 assert not self._add_cache
                 self._drain_get_cache()
+
+    def _process_batch_add(self, msg: Message) -> None:
+        """Defense in depth: workers never coalesce in sync mode (the
+        vector clocks count one request per worker per step), but a
+        batch that arrives anyway unpacks through the clock-gated
+        per-add path — each sub ticks the clocks and acks itself, so
+        BSP accounting stays exact."""
+        for sub in unpack_add_batch(msg):
+            self._process_add(sub)
 
     # ref: src/server.cpp:165-188
     def _process_get(self, msg: Message) -> None:

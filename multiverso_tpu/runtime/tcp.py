@@ -82,10 +82,10 @@ def _serialize(msg: Message) -> bytes:
     blobs: List[bytes] = []
     for blob in msg.data:
         # Device payloads cross the wire as host bytes (the reference's
-        # serialize step; ref: mpi_net.h:289-317).
-        arr = np.asarray(blob.data)
-        blobs.append(np.ascontiguousarray(arr).view(np.uint8)
-                     .reshape(-1).tobytes())
+        # serialize step; ref: mpi_net.h:289-317). Codec-filtered blobs
+        # (header slot CODEC_SLOT set by the communicator) are already
+        # uint8 frames and pass through unchanged.
+        blobs.append(blob.wire_bytes().tobytes())
     header = _HDR.pack(*[int(v) for v in msg.header])
     parts.append(header)
     parts.append(_NBLOBS.pack(len(blobs)))

@@ -6,17 +6,39 @@ it asks the table to ``partition`` the request into per-server-shard blob
 lists, re-arms the table's waiter to the shard count, and sends one message
 per shard through the communicator; on replies it hands the payload back to
 the table and counts down the waiter.
+
+Extension over the reference: SHARD-MESSAGE COALESCING. Over a real wire
+every message pays a dispatch roundtrip (~92 ms measured on the tunneled
+bench platform), so Add shards bound for the same server are staged and
+flushed as ONE ``Request_BatchAdd`` wire message. The window is the actor
+mailbox itself: while more requests are queued the batch grows (bounded by
+count/byte caps); the moment the mailbox drains — i.e. the trainer thread
+is about to wait on a reply — everything pending flushes. Gets flush first
+(per-connection FIFO keeps add-before-get ordering only if the adds are
+actually on the wire), and BSP sync mode disables coalescing outright (the
+sync server's vector clocks count one request per worker per step).
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List
 
-from ..core.message import Message, MsgType, take_error
-from ..util.configure import get_flag
+import numpy as np
+
+from ..core.message import (Message, MsgType, pack_add_batch, take_error)
+from ..util.configure import define_bool, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from .actor import Actor
+
+define_bool("coalesce_adds", True,
+            "batch pending Add shards to the same server into one wire "
+            "message (async mode over a wire transport only)")
+
+#: Flush a server's staged batch at these caps even while the mailbox is
+#: still busy — an unbounded batch would trade latency for no extra win.
+MAX_BATCH_MSGS = 64
+MAX_BATCH_BYTES = 4 << 20
 
 
 class Worker(Actor):
@@ -27,6 +49,17 @@ class Worker(Actor):
         self.register_handler(MsgType.Request_Add, self._process_add)
         self.register_handler(MsgType.Reply_Get, self._process_reply_get)
         self.register_handler(MsgType.Reply_Add, self._process_reply_add)
+        self.register_handler(MsgType.Reply_BatchAdd,
+                              self._process_reply_batch_add)
+        # Coalescing only pays where messages pay: a wire transport in
+        # async mode. In-process fabrics move object references (zero
+        # per-message wire cost) and the BSP sync server counts one
+        # request per worker per step on its vector clocks.
+        self._coalesce = (bool(get_flag("coalesce_adds"))
+                          and not self._zoo.net.in_process
+                          and not get_flag("sync", False))
+        self._pending: Dict[int, List[Message]] = {}  # dst rank -> shards
+        self._pending_bytes: Dict[int, int] = {}
 
     def register_table(self, worker_table) -> int:
         self._cache.append(worker_table)
@@ -36,9 +69,29 @@ class Worker(Actor):
         for table in self._cache:
             table.abort(reason)
 
+    # -- main loop: drain mailbox, flush staged adds on idle --
+    def _main(self) -> None:
+        while True:
+            msg = self.mailbox.pop()
+            if msg is None:
+                # Drain-exit: whatever is still staged must hit the wire
+                # — a worker stopping with unsent adds would lose them.
+                self._flush_pending()
+                break
+            self._safe_dispatch(msg)
+            if self._pending and self.mailbox.empty():
+                # The mailbox just went idle: the requester is (or is
+                # about to be) blocked in wait(); holding the batch any
+                # longer adds latency without adding batch members.
+                self._flush_pending()
+
     # ref: src/worker.cpp:30-51
     def _process_get(self, msg: Message) -> None:
         with monitor("WORKER_PROCESS_GET"):
+            # Per-connection FIFO only orders what is actually ON the
+            # wire: staged adds must flush before a Get so the server
+            # observes add-before-get program order.
+            self._flush_pending()
             self._partition_and_send(msg, MsgType.Request_Get)
 
     # ref: src/worker.cpp:53-76
@@ -77,12 +130,43 @@ class Worker(Actor):
             raise
         table.reset(msg.msg_id, len(partitions))
         for server_id, blobs in partitions.items():
-            shard = Message(src=self._zoo.rank,
-                            dst=self._zoo.server_rank(server_id),
+            dst = self._zoo.server_rank(server_id)
+            shard = Message(src=self._zoo.rank, dst=dst,
                             msg_type=msg_type,
                             table_id=msg.table_id, msg_id=msg.msg_id)
             shard.data = list(blobs)
-            self.send_to(actors.COMMUNICATOR, shard)
+            if (self._coalesce and msg_type == MsgType.Request_Add
+                    and dst != self._zoo.rank):
+                self._stage_add(dst, shard)
+            else:
+                self.send_to(actors.COMMUNICATOR, shard)
+
+    # -- coalescing --
+    def _stage_add(self, dst: int, shard: Message) -> None:
+        staged = self._pending.setdefault(dst, [])
+        staged.append(shard)
+        self._pending_bytes[dst] = self._pending_bytes.get(dst, 0) \
+            + sum(b.size for b in shard.data)
+        if (len(staged) >= MAX_BATCH_MSGS
+                or self._pending_bytes[dst] >= MAX_BATCH_BYTES):
+            self._flush_dst(dst)
+
+    def _flush_pending(self) -> None:
+        for dst in list(self._pending):
+            self._flush_dst(dst)
+
+    def _flush_dst(self, dst: int) -> None:
+        staged = self._pending.pop(dst, None)
+        self._pending_bytes.pop(dst, None)
+        if not staged:
+            return
+        if len(staged) == 1:
+            # A lone shard skips the batch framing (no descriptor
+            # overhead, and the server's plain-Add path stays hot).
+            self.send_to(actors.COMMUNICATOR, staged[0])
+            return
+        with monitor("WORKER_COALESCE_FLUSH"):
+            self.send_to(actors.COMMUNICATOR, pack_add_batch(staged))
 
     # ref: src/worker.cpp:78-84
     def _process_reply_get(self, msg: Message) -> None:
@@ -112,3 +196,39 @@ class Worker(Actor):
         if error is not None:
             table.fail(msg.msg_id, error, count=False)
         table.notify(msg.msg_id)
+
+    def _process_reply_batch_add(self, msg: Message) -> None:
+        """One coalesced ack: notify every sub-add's waiter, surfacing
+        per-sub server errors through the same fail-then-wait path an
+        individual Reply_Add would take."""
+        error = take_error(msg)
+        if error is not None:
+            # Whole-batch failure with no descriptor: the server could
+            # not even parse which subs the batch carried, so the
+            # waiters cannot be mapped to acks. A stranded waiter is
+            # the one unacceptable outcome — abort the table layer so
+            # every blocked wait() raises instead of hanging (this only
+            # happens on frame corruption, where transport integrity is
+            # gone anyway).
+            from ..util import log
+            log.error("worker: batch add rejected wholesale by the "
+                      "server (%s); aborting table waits", error)
+            self.abort_tables(
+                f"batch add rejected wholesale by rank {msg.src}: "
+                f"{error}")
+            return
+        desc = msg.data[0].as_array(np.int32)
+        err_blobs = msg.data[1:]
+        err_idx = 0
+        for i in range(int(desc[0])):
+            table_id, msg_id, failed = (int(v)
+                                        for v in desc[1 + 3 * i:4 + 3 * i])
+            table = self._cache[table_id]
+            if failed:
+                text = bytes(err_blobs[err_idx].as_array(np.uint8)) \
+                    .decode(errors="replace") \
+                    if err_idx < len(err_blobs) \
+                    else "batched add failed on the server"
+                err_idx += 1
+                table.fail(msg_id, text, count=False)
+            table.notify(msg_id)

@@ -162,9 +162,15 @@ class Zoo:
 
     # -- registration protocol (ref: src/zoo.cpp:116-145) --
     def _register_node(self, role: int) -> None:
+        from ..util.wire_codec import CAP_WIRE_CODEC
+        caps = CAP_WIRE_CODEC if get_flag("wire_codec") else 0
         msg = Message(src=self.rank, dst=CONTROLLER_RANK,
                       msg_type=MsgType.Control_Register)
-        msg.push(Blob(np.array([self.rank, role], dtype=np.int32)))
+        # Third int advertises wire capabilities (codec negotiation);
+        # a controller that only reads [:2] still registers this rank,
+        # it just never learns the capability — which degrades to
+        # passthrough, the safe direction.
+        msg.push(Blob(np.array([self.rank, role, caps], dtype=np.int32)))
         self.send_to(actors.COMMUNICATOR, msg)
         reply = self._pop_control()
         assert reply is not None and reply.type == MsgType.Control_Reply_Register
@@ -177,8 +183,23 @@ class Zoo:
             node.server_id = int(server_id)
         self._num_workers = int(counts[0])
         self._num_servers = int(counts[1])
-        log.debug("Rank %d registered: workers=%d servers=%d",
-                  self.rank, self._num_workers, self._num_servers)
+        # Per-rank capability vector (reply blob 2). An older controller
+        # that doesn't broadcast it leaves every peer at 0 = passthrough.
+        if len(reply.data) >= 3:
+            self._peer_caps = reply.data[2].as_array(np.int32).copy()
+        else:
+            self._peer_caps = np.zeros(self.net_size, dtype=np.int32)
+        log.debug("Rank %d registered: workers=%d servers=%d caps=%s",
+                  self.rank, self._num_workers, self._num_servers,
+                  self._peer_caps.tolist())
+
+    def peer_caps(self, rank: int) -> int:
+        """Wire capabilities the peer advertised at registration
+        (0 before registration completes / for pre-codec peers)."""
+        caps = getattr(self, "_peer_caps", None)
+        if caps is None or not 0 <= rank < len(caps):
+            return 0
+        return int(caps[rank])
 
     # -- identity --
     @property

@@ -41,15 +41,23 @@ from ..core.blob import Blob, is_device_array
 from ..core.message import MsgType
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, GetOption, UpdateEngine, create_rule
-from ..updater.engine import pad_ids
+from ..updater.engine import bucket_size, pad_ids
+from ..util import wire_codec
 from ..util.configure import define_bool, get_flag
 from ..util.log import CHECK
-from ..util.quantization import OneBitFilter, SparseFilter
+from ..util.quantization import OneBitFilter
 from .table_interface import ServerTable, WorkerTable
 
 define_bool("sparse_compress", True,
-            "run sparse-matrix wire traffic through SparseFilter "
-            "(ref: sparse_matrix_table.cpp:148-153)")
+            "run sparse-matrix wire traffic through the compact wire "
+            "codec (ref: sparse_matrix_table.cpp:148-153; float64-pair "
+            "format replaced by int32-index + typed-value frames)")
+define_bool("verify_device_ids", False,
+            "debug: on the first fused add+dirty-get, read the "
+            "row_ids_device mirror back to the host and CHECK it "
+            "matches the host ids (turns the documented silent-"
+            "corruption mode of a disagreeing mirror into a loud "
+            "failure; costs one device->host transfer)")
 define_bool("one_bit_push", False,
             "1-bit quantize matrix Add traffic (sign bitmap + per-sign "
             "means, worker-side error feedback) — ~32x smaller pushes "
@@ -94,19 +102,34 @@ def _onebit_decode(bits_blob: Blob, meta_blob: Blob) -> np.ndarray:
          float(meta[1]), int(meta[2])))
 
 
-def _compress_values(values: np.ndarray) -> List[Blob]:
-    """[values] -> [values(maybe (index,value) pairs), size_record]
-    (ref: quantization_util.h:37-137)."""
-    comp, sizes = SparseFilter().filter_in([values.reshape(-1)])
-    return [Blob(comp[0]), Blob(sizes)]
+def _compress_values(values: np.ndarray, lossy: bool = False):
+    """values -> ([codec frame blob], residual). One self-describing
+    frame replaces the old [float64 pairs, size_record] two-blob layout
+    (ref layout: quantization_util.h:37-137) — int32 indices + typed
+    values, 8 bytes/pair lossless instead of 16. ``residual`` is the
+    error-feedback vector when a lossy tier was chosen, else None."""
+    frame, residual = wire_codec.encode_blob(
+        np.asarray(values).reshape(-1), lossy=lossy)
+    return [Blob(np.frombuffer(frame, np.uint8))], residual
 
 
-def _decompress_values(values_blob: Blob, sizes_blob: Blob,
-                       dtype) -> np.ndarray:
-    sizes = sizes_blob.as_array(np.int64)
-    raw = values_blob.as_array(
-        np.float64 if sizes[0] != -1 else dtype)
-    return SparseFilter().filter_out([raw], sizes, dtype=dtype)[0]
+def _decompress_values(values_blob: Blob, dtype) -> np.ndarray:
+    full = wire_codec.decode_blob(values_blob.as_array(np.uint8))
+    return full.astype(dtype, copy=False)
+
+
+def _is_codec_blob(blob: Blob) -> bool:
+    """True when the blob carries a codec frame. Receivers with
+    ``_compress`` set sniff before decoding so a peer sending RAW
+    values (cross-rank -sparse_compress flag mismatch) degrades to the
+    uncompressed layout instead of raising inside the actor loop and
+    stranding the requester's waiter. NOTE this does NOT extend to the
+    REMOVED float64-pair format: a pre-codec build's compressed
+    traffic is a declared wire break (docs/WIRE_FORMAT.md) — its
+    3-blob pair layout fails the blob-count/size CHECKs loudly rather
+    than being decoded."""
+    return not blob.on_device \
+        and wire_codec.is_codec_frame(blob.as_array(np.uint8))
 
 
 def _shaped_rows(values, n_rows: int, num_col: int):
@@ -193,8 +216,13 @@ class MatrixWorker(WorkerTable):
         self._compress = (self.is_sparse
                           and not self._zoo.net.in_process
                           and bool(get_flag("sparse_compress")))
+        # Lossy value tiers (fp16 / int8-with-per-chunk-scale) for Add
+        # pushes only, with worker-side error feedback; pulls stay
+        # lossless (the server keeps no per-consumer residual state).
+        self._lossy = (self._compress and self.dtype == np.float32
+                       and bool(get_flag("wire_codec_lossy")))
         # 1-bit push quantization (dense float32 tables; sparse traffic
-        # already rides SparseFilter). Pulls stay full precision — only
+        # already rides the wire codec). Pulls stay full precision — only
         # gradient pushes quantize. The worker-side error-feedback buffer
         # is table-shaped (1-bit SGD's standard memory cost).
         self._one_bit = (not self.is_sparse
@@ -211,6 +239,7 @@ class MatrixWorker(WorkerTable):
         self._dest_rows: Optional[np.ndarray] = None  # requested row-id vector
         self._device_shards: Optional[Dict[int, object]] = None
         self._device_shard_ids: Optional[Dict[int, np.ndarray]] = None
+        self._mirror_verified = False  # -verify_device_ids: once per table
 
     def _check_row_ids(self, row_ids: np.ndarray) -> None:
         """Fail fast in the CALLER on out-of-range ids. partition() runs
@@ -350,6 +379,12 @@ class MatrixWorker(WorkerTable):
               "one segment per server")
         CHECK(all(is_device_array(s) for s in segments),
               "segments must be device arrays")
+        # Shape/dtype violations would otherwise surface inside the
+        # server actor, where _safe_dispatch swallows the exception and
+        # the caller hangs in wait() forever — fail in the CALLER.
+        for seg in segments:
+            CHECK(np.dtype(seg.dtype) == np.int32 and len(seg.shape) == 1,
+                  "segments must be 1-D int32 id vectors")
         CHECK(not self._compress, "device gets bypass wire compression")
         self._dest, self._dest_rows = None, None
         self._device_shards = {}
@@ -375,6 +410,13 @@ class MatrixWorker(WorkerTable):
         for seg, delta in zip(segments, deltas):
             CHECK(is_device_array(seg) and is_device_array(delta),
                   "segments and deltas must be device arrays")
+            # Fail in the CALLER: inside the server actor these would
+            # be swallowed by _safe_dispatch and the Add ack never
+            # comes, hanging the caller in wait().
+            CHECK(np.dtype(seg.dtype) == np.int32 and len(seg.shape) == 1,
+                  "segments must be 1-D int32 id vectors")
+            CHECK(np.dtype(delta.dtype) == self.dtype,
+                  "segment delta dtype must match the table dtype")
             CHECK(tuple(delta.shape) ==
                   tuple(seg.shape) + (self.num_col,),
                   "bad segment delta shape")
@@ -454,12 +496,12 @@ class MatrixWorker(WorkerTable):
                                       self._option_blob(option))
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
         self._check_row_ids(row_ids)
-        if self._one_bit:
-            # _onebit_chunk's error-feedback gather/write-back breaks on
-            # duplicates; its own CHECK fires inside the worker actor —
-            # raise here in the caller instead.
+        if self._one_bit or self._lossy:
+            # The error-feedback gather/write-back breaks on duplicates;
+            # the chunk encoder's own CHECK fires inside the worker
+            # actor — raise here in the caller instead.
             CHECK(np.unique(row_ids).size == row_ids.size,
-                  "one-bit row pushes need unique row ids")
+                  "error-feedback row pushes need unique row ids")
         if not is_device_array(delta):
             delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
         CHECK(int(np.prod(delta.shape)) == row_ids.size * self.num_col,
@@ -473,32 +515,50 @@ class MatrixWorker(WorkerTable):
             option = AddOption(worker_id=max(self._zoo.worker_id, 0))
         return option.to_blob()
 
-    def _onebit_chunk(self, chunk: np.ndarray, lo: int, hi: int,
-                      rows: Optional[np.ndarray] = None) -> List[Blob]:
-        """Encode one server chunk with error feedback: the previous
+    def _feedback_chunk(self, chunk, lo: int, hi: int,
+                        rows: Optional[np.ndarray], encode) -> List[Blob]:
+        """Shared error-feedback discipline for every lossy encoder
+        (1-bit and the codec's quantized tiers): the previous
         quantization error for these slots is folded into the delta
         before encoding, and the new error replaces it. Row pushes need
         UNIQUE row ids — a duplicated row would gather its residual once
         per occurrence and keep only the last write-back, so the bounded-
-        error invariant would silently break."""
+        error invariant would silently break. ``encode`` maps a flat
+        fp32 vector to (blobs, residual); residual None means the
+        encoder went lossless this time (nothing remains to carry)."""
         if self._residual is None:
             self._residual = np.zeros((self.num_row, self.num_col),
                                       np.float32)
-        chunk2d = chunk.reshape(-1, self.num_col)
+        chunk2d = np.asarray(chunk).reshape(-1, self.num_col)
         if rows is None:
             res = self._residual[lo:hi]
         else:
             CHECK(np.unique(rows).size == rows.size,
-                  "one-bit row pushes need unique row ids")
+                  "error-feedback row pushes need unique row ids")
             res = self._residual[rows]
-        blobs, residual = _onebit_blobs(
-            (chunk2d + res).reshape(-1))
+        blobs, residual = encode((chunk2d + res).reshape(-1))
+        if residual is None:
+            residual = np.zeros(chunk2d.size, np.float32)
         residual = residual.reshape(chunk2d.shape)
         if rows is None:
             self._residual[lo:hi] = residual
         else:
             self._residual[rows] = residual
         return blobs
+
+    def _onebit_chunk(self, chunk: np.ndarray, lo: int, hi: int,
+                      rows: Optional[np.ndarray] = None) -> List[Blob]:
+        return self._feedback_chunk(chunk, lo, hi, rows, _onebit_blobs)
+
+    def _codec_chunk(self, chunk: np.ndarray, lo: int, hi: int,
+                     rows: Optional[np.ndarray] = None) -> List[Blob]:
+        """Wire-codec Add chunk: lossless passthrough by default, the
+        quantized tiers + error feedback under ``-wire_codec_lossy``."""
+        if not self._lossy:
+            return _compress_values(np.asarray(chunk))[0]
+        return self._feedback_chunk(
+            chunk, lo, hi, rows,
+            lambda flat: _compress_values(flat, lossy=True))
 
     # -- partition (ref: matrix_table.cpp:234-315) --
     def partition(self, blobs, msg_type) -> Dict[int, List[Blob]]:
@@ -561,7 +621,8 @@ class MatrixWorker(WorkerTable):
                     chunk = values[lo:hi] if row_shaped \
                         else values[lo * self.num_col:hi * self.num_col]
                     if compress:
-                        shard.extend(_compress_values(np.asarray(chunk)))
+                        shard.extend(self._codec_chunk(
+                            np.asarray(chunk), lo, hi))
                     elif one_bit:
                         shard.extend(self._onebit_chunk(
                             np.asarray(chunk), lo, hi))
@@ -608,7 +669,8 @@ class MatrixWorker(WorkerTable):
             elif values is not None:
                 chunk = np.ascontiguousarray(values[mask])
                 if self._compress:
-                    shard.extend(_compress_values(chunk))
+                    shard.extend(self._codec_chunk(chunk, 0, 0,
+                                                   rows=keys[mask]))
                 elif self._one_bit:
                     shard.extend(self._onebit_chunk(chunk, 0, 0,
                                                     rows=keys[mask]))
@@ -705,14 +767,38 @@ class MatrixWorker(WorkerTable):
         if row_ids_device is not None:
             CHECK(is_device_array(row_ids_device),
                   "row_ids_device must be a device array")
-            # A mirror that disagrees with the host ids would mark one
-            # row set dirty and scatter the delta at ANOTHER (silent
-            # corruption), or crash inside the server actor (hang).
-            CHECK(tuple(row_ids_device.shape) == (row_ids.size,)
+            # The mirror must arrive PRE-PADDED to the same power-of-two
+            # bucket the host path uses (``pad_ids(row_ids, num_row)``
+            # then ``jnp.asarray``): the server feeds it straight into
+            # the fused jit, so an exact-k mirror would compile one
+            # program per distinct k (10s+ per recompile on the
+            # tunneled platform) instead of once per bucket width.
+            # Padding ids must be >= num_row: they scatter zero rows
+            # into dead storage and are dropped by every gather.
+            bucket = bucket_size(row_ids.size)
+            CHECK(tuple(row_ids_device.shape) == (bucket,)
                   and np.dtype(row_ids_device.dtype) == np.int32,
-                  "row_ids_device must mirror row_ids ([k] int32)")
+                  "row_ids_device must mirror row_ids padded to the "
+                  "host bucket ([bucket_size(k)] int32; build it as "
+                  "jnp.asarray(pad_ids(row_ids, num_row)))")
             CHECK(self._updater_stateless,
                   "device-id fused adds need a stateless updater")
+            if get_flag("verify_device_ids") and not self._mirror_verified:
+                # A mirror that disagrees with the host ids would mark
+                # one row set dirty and scatter the delta at ANOTHER —
+                # silent corruption. Opt-in first-call readback turns
+                # that into a loud failure (one device->host transfer).
+                host_mirror = np.asarray(row_ids_device)
+                CHECK(np.array_equal(host_mirror[:row_ids.size], row_ids),
+                      "-verify_device_ids: row_ids_device disagrees "
+                      "with the host row ids")
+                CHECK(row_ids.size == bucket
+                      or int(host_mirror[row_ids.size:].min())
+                      >= self.num_row,
+                      "-verify_device_ids: mirror padding ids must be "
+                      ">= num_row (in-range padding would scatter into "
+                      "live rows)")
+                self._mirror_verified = True
             blobs.append(Blob(row_ids_device))
         self.wait(self.request_async_raw(MsgType.Request_Get, blobs))
         shards, ids = self._device_shards, self._device_shard_ids
@@ -774,11 +860,18 @@ class MatrixWorker(WorkerTable):
             if self._device_shard_ids is not None:
                 self._device_shard_ids[sid] = keys
             return
-        if self._compress and len(reply_blobs) == 3:
+        if self._compress and _is_codec_blob(reply_blobs[1]):
             values = _decompress_values(
-                reply_blobs[1], reply_blobs[2],
+                reply_blobs[1],
                 self.dtype).reshape(keys.size, self.num_col)
         else:
+            # A 3-blob non-codec reply here is the REMOVED float64-pair
+            # layout ([keys, pairs, size_record] from a pre-codec
+            # build) — fail loudly; reshaping pair bytes as raw values
+            # could silently corrupt when the byte counts coincide.
+            CHECK(not self._compress or len(reply_blobs) == 2,
+                  "legacy float64-pair reply: the pre-codec wire "
+                  "format was removed (docs/WIRE_FORMAT.md)")
             values = reply_blobs[1].as_array(self.dtype).reshape(
                 keys.size, self.num_col)
         if self._dest_rows is None:
@@ -881,15 +974,16 @@ class MatrixServer(ServerTable):
                 bounds=self._shard_bounds)
             return
         keys = blobs[0].as_array(np.int32)
-        if self._compress:
-            # Compressed wire layout: [keys, values, size_record(, option)]
-            # (ref decompression on receive: sparse_matrix_table.cpp:
-            # 148-153).
-            CHECK(len(blobs) in (3, 4), "compressed add needs "
-                  "[keys, values, sizes(, option)]")
-            option = AddOption.from_blob(blobs[3]) \
-                if len(blobs) == 4 else None
-            delta = _decompress_values(blobs[1], blobs[2], self.dtype)
+        if self._compress and len(blobs) in (2, 3) \
+                and _is_codec_blob(blobs[1]):
+            # Compressed wire layout: [keys, codec frame(, option)] —
+            # the frame is self-describing (tier + counts in its header;
+            # ref decompression on receive: sparse_matrix_table.cpp:
+            # 148-153). Magic-sniffed: a peer running without the
+            # table-level codec falls through to the raw layouts below.
+            option = AddOption.from_blob(blobs[2]) \
+                if len(blobs) == 3 else None
+            delta = _decompress_values(blobs[1], self.dtype)
         elif self._one_bit and len(blobs) == 4 \
                 and not blobs[1].on_device:
             # 1-bit wire layout: exactly [keys, sign bits, meta, option]
@@ -981,9 +1075,10 @@ class MatrixServer(ServerTable):
 
     def _reply_values(self, values) -> List[Blob]:
         """Get replies run through the wire filter for sparse tables
-        (ref: sparse_matrix_table.cpp:261-308)."""
+        (ref: sparse_matrix_table.cpp:261-308). Always lossless — the
+        server keeps no per-consumer error-feedback state."""
         if self._compress:
-            return _compress_values(np.asarray(values))
+            return _compress_values(np.asarray(values))[0]
         return [Blob(values)]
 
     def _fused_add_get_dirty(self, blobs: List[Blob]) -> List[Blob]:
@@ -1007,7 +1102,9 @@ class MatrixServer(ServerTable):
         dirty = self._dirty_ids(get_opt.worker_id)
         if len(blobs) == 6:
             # Device mirror of the add ids — single server owns row
-            # offset 0, so global ids ARE local ids.
+            # offset 0, so global ids ARE local ids. Arrives BUCKET-
+            # PADDED (caller contract), matching the host path below so
+            # the fused program compiles once per bucket width.
             add_ids = blobs[5].typed(np.int32)
         else:
             add_ids = pad_ids(local, self._data.shape[0])

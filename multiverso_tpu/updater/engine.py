@@ -160,9 +160,18 @@ class UpdateEngine:
         launch whose cost scales with its buffer arguments — for the
         sparse dirty-row roundtrip (add, then dirty get) that overhead
         is the measured bound, and fusing the pair halves it. Both id
-        vectors arrive host-padded (out-of-range drops/zero-fills);
-        the delta pads in-jit like apply_rows."""
+        vectors MUST arrive padded to power-of-two buckets
+        (out-of-range drops/zero-fills); the delta pads in-jit like
+        apply_rows. Device-mirror ids are held to the same contract —
+        an exact-k mirror would recompile the fused program for every
+        distinct k (10s+ each on this platform) instead of once per
+        bucket width."""
         hyp, worker_id = _unpack(option)
+        from ..util.log import CHECK
+        k = int(np.shape(row_ids)[0])
+        CHECK(k == bucket_size(k),
+              "apply_rows_gather ids must be bucket-padded "
+              "(pad_ids on the host, a pad_ids-built device mirror)")
         fn = self._rows_gather.get(n_col)
         if fn is None:
             rule_rows = self.rule.rows

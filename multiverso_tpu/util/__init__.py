@@ -1,4 +1,4 @@
-from . import configure, log
+from . import configure, log, wire_codec
 from .async_buffer import ASyncBuffer
 from .dashboard import Dashboard, Monitor, monitor, trace_to
 from .mt_queue import MtQueue
@@ -7,7 +7,7 @@ from .timer import Timer
 from .waiter import Waiter
 
 __all__ = [
-    "configure", "log", "ASyncBuffer", "Dashboard", "Monitor", "monitor",
-    "MtQueue", "OneBitFilter", "SparseFilter", "Timer", "Waiter",
-    "trace_to",
+    "configure", "log", "wire_codec", "ASyncBuffer", "Dashboard",
+    "Monitor", "monitor", "MtQueue", "OneBitFilter", "SparseFilter",
+    "Timer", "Waiter", "trace_to",
 ]

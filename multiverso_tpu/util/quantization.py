@@ -3,13 +3,15 @@
 TPU-native equivalent of the reference's ``SparseFilter``
 (ref: include/multiverso/util/quantization_util.h:25-158). Per payload blob:
 if more than half of the values are within ``clip_value`` of zero, the blob
-is rewritten as (index, value) pairs; a side "size record" carries the
+is rewritten as a compact codec frame (int32 indices + typed values — see
+``multiverso_tpu.util.wire_codec``); a side "size record" carries the
 original element count, with -1 meaning "left uncompressed". ``filter_in``
 compresses an outgoing list of arrays, ``filter_out`` reverses it.
 
-Vectorized with numpy (the reference loops element-wise); on-device
-equivalents for ICI paths live in ``multiverso_tpu.parallel.collectives``
-(top-k / threshold sparsification before a ragged all-to-all).
+The reference encoded surviving pairs as float64 (16 bytes per pair,
+break-even only below 50% density); that format is REMOVED — frames are
+now int32 index + fp32 value (8 bytes per pair, lossless) or the codec's
+quantized tiers when the caller opts into lossy transport.
 
 The reference's ``OneBitsFilter`` is an empty stub
 (quantization_util.h:160-161); here ``OneBitFilter`` implements the standard
@@ -19,24 +21,33 @@ as the functional completion of that stub.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from . import wire_codec
 
 UNCOMPRESSED = -1
 
 
 class SparseFilter:
-    def __init__(self, clip_value: float = 0.0):
+    def __init__(self, clip_value: float = 0.0, lossy: bool = False):
         self._clip = float(clip_value)
+        self._lossy = bool(lossy)
+        #: Error-feedback residual of the last lossy ``filter_in`` (one
+        #: entry per blob; None where the encoding was lossless). The
+        #: caller folds it into the next delta, OneBitFilter-style.
+        self.last_residuals: List[Optional[np.ndarray]] = []
 
-    def filter_in(self, blobs: Sequence[np.ndarray]) -> Tuple[List[np.ndarray], np.ndarray]:
+    def filter_in(self, blobs: Sequence[np.ndarray]
+                  ) -> Tuple[List[np.ndarray], np.ndarray]:
         """Compress each blob independently.
 
         Returns (compressed_blobs, size_record) where size_record[i] is the
         original element count if blob i was compressed, else UNCOMPRESSED.
         """
         out: List[np.ndarray] = []
+        self.last_residuals = []
         sizes = np.empty(len(blobs), dtype=np.int64)
         for i, blob in enumerate(blobs):
             arr = np.asarray(blob)
@@ -44,17 +55,14 @@ class SparseFilter:
             nonzero = np.abs(flat) > self._clip
             n_keep = int(np.count_nonzero(nonzero))
             if flat.size > 0 and n_keep * 2 < flat.size:
-                idx = np.nonzero(nonzero)[0]
-                vals = flat[idx]
-                # float64 pairs: holds indices exactly up to 2^53 and float32
-                # values exactly; halves the wire size whenever <50% survive.
-                pairs = np.empty(idx.size * 2, dtype=np.float64)
-                pairs[0::2] = idx
-                pairs[1::2] = vals
-                out.append(pairs)
+                frame, residual = wire_codec.encode_blob(
+                    flat, lossy=self._lossy, clip=self._clip)
+                out.append(np.frombuffer(frame, np.uint8))
+                self.last_residuals.append(residual)
                 sizes[i] = flat.size
             else:
                 out.append(flat)
+                self.last_residuals.append(None)
                 sizes[i] = UNCOMPRESSED
         return out, sizes
 
@@ -66,11 +74,8 @@ class SparseFilter:
             if size == UNCOMPRESSED:
                 out.append(np.asarray(blob, dtype=dtype))
                 continue
-            pairs = np.asarray(blob, dtype=np.float64)
-            full = np.zeros(int(size), dtype=dtype)
-            idx = pairs[0::2].astype(np.int64)
-            full[idx] = pairs[1::2].astype(dtype)
-            out.append(full)
+            full = wire_codec.decode_blob(np.asarray(blob))
+            out.append(full.astype(dtype, copy=False))
         return out
 
 

@@ -238,6 +238,100 @@ print("BINDCONNECT_OK")
     assert all("BINDCONNECT_OK" in o for o in outs)
 
 
+def test_mixed_version_codec_negotiation(tmp_path):
+    # Rank 0 runs with the wire codec, rank 1 emulates a pre-codec peer
+    # (-wire_codec=false: advertises nothing, encodes nothing, and will
+    # NOT decode). Negotiation must keep every frame toward rank 1
+    # plain, so the cluster works end to end — merely uncompressed in
+    # that direction — with exact values both ways.
+    n = 2
+    mf, _ = write_machine_file(tmp_path, n)
+    body = f"""
+flags = ["-machine_file={mf}", "-rank=" + str(rank)]
+if rank == 1:
+    flags.append("-wire_codec=false")
+mv.init(flags)
+zoo = mv.current_zoo()
+from multiverso_tpu.util.wire_codec import CAP_WIRE_CODEC
+assert zoo.peer_caps(0) & CAP_WIRE_CODEC, zoo._peer_caps
+assert zoo.peer_caps(1) == 0, zoo._peer_caps
+matrix = mv.create_matrix_table(64, 33, is_sparse=True)
+if rank == 0:
+    delta = np.zeros((3, 33), np.float32)
+    delta[:, 5] = [1.5, -2.0, 3.25]
+    matrix.add_rows(np.array([0, 31, 63], np.int32), delta)
+mv.barrier()
+out = matrix.get()
+assert out[0, 5] == 1.5 and out[31, 5] == -2.0 and out[63, 5] == 3.25, out
+assert abs(out.sum() - 2.75) < 1e-6, out.sum()
+mv.barrier()
+mv.shutdown()
+print("MIXED_CODEC_OK")
+"""
+    outs = run_cluster([body] * n)
+    assert all("MIXED_CODEC_OK" in o for o in outs)
+
+
+def test_coalesced_adds_over_tcp(tmp_path):
+    # Async-mode burst of Adds: the worker must coalesce shards bound
+    # for the same server into Request_BatchAdd frames (observable via
+    # the server-side dashboard monitor), every ack must arrive (the
+    # final wait() returns), and the summed result must be exact. One
+    # sub-add carries bad row ids: its error must come back through the
+    # batched ack without poisoning the siblings.
+    n = 2
+    mf, _ = write_machine_file(tmp_path, n)
+    body = f"""
+mv.init(["-machine_file={mf}", "-rank=" + str(rank)])
+table = mv.create_array_table(32)
+matrix = mv.create_matrix_table(8, 4)  # collective: servers on BOTH ranks
+if rank == 1:
+    ids = [table.add_async(np.full(32, 1.0, np.float32))
+           for _ in range(20)]
+    for i in ids:
+        table.wait(i)
+    from multiverso_tpu.tables.table_interface import TableRequestError
+    ok1 = matrix.add_rows_async(np.array([2], np.int32),
+                                np.ones((1, 4), np.float32))
+    # A doomed whole-table add rides the same burst: 5 floats against a
+    # 4x4 shard passes partition (host-side slicing is silent) and
+    # fails the SERVER-side size CHECK — its error must come back
+    # through the (possibly batched) ack without poisoning siblings.
+    from multiverso_tpu.core.blob import Blob
+    doomed = matrix.add_async_raw(
+        Blob(np.array([-1], np.int32).view(np.uint8)),
+        Blob(np.ones(5, np.float32)))
+    ok2 = matrix.add_rows_async(np.array([3], np.int32),
+                                np.full((1, 4), 2.0, np.float32))
+    matrix.wait(ok1)
+    try:
+        matrix.wait(doomed)
+        raise SystemExit("BATCH_ERROR_LOST")
+    except TableRequestError:
+        pass
+    matrix.wait(ok2)
+    buf = matrix.get()
+    assert np.allclose(buf[2], 1.0) and np.allclose(buf[3], 2.0), buf
+    from multiverso_tpu.util.dashboard import Dashboard
+    flushes = Dashboard.get("WORKER_COALESCE_FLUSH").count
+    # The 20-add burst outruns the worker actor (it serializes and
+    # ships each shard over a real socket), so at least one multi-add
+    # batch must have formed — without this assert, a regression that
+    # silently disables staging would leave the test green via the
+    # plain per-shard path.
+    assert flushes >= 1, flushes
+    print("BATCH_FLUSHES", flushes)
+mv.barrier()
+out = table.get()
+assert np.allclose(out, 20.0), out
+mv.barrier()
+mv.shutdown()
+print("COALESCE_OK", rank)
+"""
+    outs = run_cluster([body] * n)
+    assert all("COALESCE_OK" in o for o in outs)
+
+
 def test_peer_death_aborts_instead_of_hanging(tmp_path):
     # Failure detection (absent in the reference — a dead MPI rank hangs
     # the cluster, SURVEY.md section 5.3): when a peer process dies

@@ -129,3 +129,171 @@ def test_ma_mode_skips_ps():
     with pytest.raises(RuntimeError):
         zoo.send_to("worker", None)
     mv.shutdown()
+
+
+class TestAddCoalescing:
+    """Deterministic coverage of the worker's shard-message coalescing
+    (the TCP two-process flavor in test_net_integration.py exercises it
+    end to end but cannot control mailbox timing)."""
+
+    class _FakeNet:
+        in_process = False
+
+    class _FakeZoo:
+        def __init__(self):
+            self.rank = 1
+            self.num_servers = 2
+            self.net = TestAddCoalescing._FakeNet()
+            self.sent = []
+            self._actors = {}
+
+        def register_actor(self, actor):
+            self._actors[actor.name] = actor
+
+        def deregister_actor(self, actor):
+            self._actors.pop(actor.name, None)
+
+        def send_to(self, name, msg):
+            self.sent.append((name, msg))
+
+        def server_rank(self, server_id):
+            return server_id  # server 0 remote (rank 0), server 1 local
+
+    class _FakeTable:
+        def __init__(self):
+            self.events = []
+
+        def partition(self, blobs, msg_type):
+            return {0: list(blobs), 1: list(blobs)}
+
+        def reset(self, msg_id, n):
+            self.events.append(("reset", msg_id, n))
+
+        def notify(self, msg_id):
+            self.events.append(("notify", msg_id))
+
+        def fail(self, msg_id, reason, count=True):
+            self.events.append(("fail", msg_id, reason))
+
+    def _worker(self):
+        import numpy as np
+
+        from multiverso_tpu.core.blob import Blob
+        from multiverso_tpu.core.message import Message, MsgType
+        from multiverso_tpu.runtime.worker import Worker
+        from multiverso_tpu.util.configure import set_flag
+        set_flag("sync", False)
+        set_flag("coalesce_adds", True)
+        zoo = self._FakeZoo()
+        worker = Worker(zoo)  # thread never started: drive handlers
+        table = self._FakeTable()
+        worker.register_table(table)
+        def add(msg_id):
+            msg = Message(src=1, dst=-1, msg_type=MsgType.Request_Add,
+                          table_id=0, msg_id=msg_id)
+            msg.push(Blob(np.ones(4, np.float32)))
+            return msg
+        return worker, zoo, table, add, Message, MsgType
+
+    def test_remote_shards_stage_local_shards_send(self):
+        worker, zoo, table, add, Message, MsgType = self._worker()
+        assert worker._coalesce
+        worker._process_add(add(1))
+        worker._process_add(add(2))
+        # Local (dst == own rank) shards went straight out; remote
+        # shards are staged for dst rank 0.
+        assert [m.dst for _, m in zoo.sent] == [1, 1]
+        assert all(m.type == MsgType.Request_Add for _, m in zoo.sent)
+        assert len(worker._pending[0]) == 2
+        # A Get flushes the staged adds FIRST (add-before-get order on
+        # the wire), as one Request_BatchAdd.
+        get = Message(src=1, dst=-1, msg_type=MsgType.Request_Get,
+                      table_id=0, msg_id=3)
+        worker._process_get(get)
+        types = [m.type for _, m in zoo.sent]
+        batch_at = types.index(MsgType.Request_BatchAdd)
+        first_get_at = types.index(MsgType.Request_Get)
+        assert batch_at < first_get_at
+        assert not worker._pending
+        from multiverso_tpu.core.message import unpack_add_batch
+        batch = next(m for _, m in zoo.sent
+                     if m.type == MsgType.Request_BatchAdd)
+        subs = unpack_add_batch(batch)
+        assert [s.msg_id for s in subs] == [1, 2]
+        assert batch.dst == 0
+
+    def test_count_cap_flushes(self):
+        from multiverso_tpu.runtime import worker as worker_mod
+        worker, zoo, table, add, Message, MsgType = self._worker()
+        for i in range(worker_mod.MAX_BATCH_MSGS):
+            worker._process_add(add(i))
+        batches = [m for _, m in zoo.sent
+                   if m.type == MsgType.Request_BatchAdd]
+        assert len(batches) == 1  # cap reached -> flushed mid-burst
+        assert not worker._pending
+
+    def test_single_staged_shard_sends_plain(self):
+        worker, zoo, table, add, Message, MsgType = self._worker()
+        worker._process_add(add(7))
+        worker._flush_pending()
+        remote = [m for _, m in zoo.sent if m.dst == 0]
+        assert len(remote) == 1 and remote[0].type == MsgType.Request_Add
+
+    def test_sync_mode_disables_coalescing(self):
+        import numpy as np
+
+        from multiverso_tpu.runtime.worker import Worker
+        from multiverso_tpu.util.configure import set_flag
+        set_flag("sync", True)
+        try:
+            zoo = self._FakeZoo()
+            worker = Worker(zoo)
+            assert not worker._coalesce
+        finally:
+            set_flag("sync", False)
+
+    def test_malformed_batch_still_acks_every_sub(self):
+        # The reply must go out in EVERY path: a truncated batch (blob
+        # count disagrees with the descriptor) acks each sub the
+        # descriptor names as FAILED, so no waiter strands (same
+        # invariant as the per-message handlers' finally-send).
+        import numpy as np
+
+        from multiverso_tpu.core.blob import Blob
+        from multiverso_tpu.core.message import (Message, MsgType,
+                                                 pack_add_batch)
+        from multiverso_tpu.runtime.server import Server
+        zoo = self._FakeZoo()
+        server = Server(zoo)
+        subs = []
+        for i in range(2):
+            sub = Message(src=1, dst=0, msg_type=MsgType.Request_Add,
+                          table_id=0, msg_id=50 + i)
+            sub.push(Blob(np.ones(4, np.float32)))
+            subs.append(sub)
+        batch = pack_add_batch(subs)
+        batch.data = batch.data[:-1]  # truncate a payload blob
+        server._process_batch_add(batch)
+        replies = [m for _, m in zoo.sent
+                   if m.type == MsgType.Reply_BatchAdd]
+        assert len(replies) == 1
+        desc = replies[0].data[0].as_array(np.int32)
+        assert desc[0] == 2
+        assert list(desc[1:7]) == [0, 50, 1, 0, 51, 1]  # both failed
+
+    def test_batched_reply_notifies_and_fails_per_sub(self):
+        import numpy as np
+
+        from multiverso_tpu.core.blob import Blob
+        from multiverso_tpu.core.message import Message, MsgType
+        worker, zoo, table, add, _, _ = self._worker()
+        reply = Message(src=0, dst=1, msg_type=MsgType.Reply_BatchAdd)
+        reply.push(Blob(np.array([2, 0, 11, 0, 0, 12, 1], np.int32)))
+        reply.push(Blob(np.frombuffer(b"ValueError: boom", np.uint8)
+                        .copy()))
+        worker._process_reply_batch_add(reply)
+        assert ("notify", 11) in table.events
+        assert ("notify", 12) in table.events
+        fails = [e for e in table.events if e[0] == "fail"]
+        assert len(fails) == 1 and fails[0][1] == 12
+        assert "boom" in fails[0][2]

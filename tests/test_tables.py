@@ -175,7 +175,7 @@ class TestSparseMatrix:
         np.testing.assert_array_equal(out, np.zeros((4, 2), np.float32))
 
     def test_wire_compression_roundtrip_and_shrink(self, env):
-        # Sparse traffic runs through SparseFilter both directions
+        # Sparse traffic runs through the wire codec both directions
         # (ref: sparse_matrix_table.cpp:148-153): a mostly-zero row delta
         # must round-trip exactly AND shrink on the wire. In-process
         # tables skip the filter automatically (no wire), so force it on
@@ -221,6 +221,51 @@ class TestSparseMatrix:
         table.get(out=buf)
         np.testing.assert_array_equal(buf[0], dense[0])
         np.testing.assert_array_equal(buf[3], dense[1])
+
+    def test_compress_mismatch_degrades_to_raw(self, env):
+        # A peer running WITHOUT the table-level codec (-sparse_compress
+        # mismatch or a pre-codec build) sends raw [keys, values] — a
+        # compress-enabled server must sniff the frame magic and take
+        # the raw path instead of raising inside the actor loop (which
+        # would strand the requester's waiter forever).
+        table = mv.create_matrix_table(8, 16, is_sparse=True)
+        server = mv.current_zoo()._server_tables[table.table_id]
+        server._compress = True
+        table._compress = True
+        table.get()  # clean all for worker 0 (codec reply path)
+        delta = np.zeros((2, 16), np.float32)
+        delta[0, 1], delta[1, 15] = 3.0, -4.0
+        table._compress = False  # emulate a plain-sending peer's Add
+        table.add_rows(np.array([2, 6], np.int32), delta,
+                       option=AddOption(worker_id=1))
+        table._compress = True
+        buf = np.zeros((8, 16), np.float32)
+        table.get(out=buf)  # codec reply decodes exactly
+        np.testing.assert_array_equal(buf[2], delta[0])
+        np.testing.assert_array_equal(buf[6], delta[1])
+
+    def test_wire_compression_lossy_error_feedback(self, env):
+        # -wire_codec_lossy: quantized Add pushes with worker-side error
+        # feedback. Repeating the same push must converge to the exact
+        # accumulated sum (residual folding), not drift by one
+        # quantization step per iteration.
+        table = mv.create_matrix_table(8, 64, is_sparse=True)
+        table._compress = True
+        table._lossy = True
+        mv.current_zoo()._server_tables[table.table_id]._compress = True
+        table.get()  # clean all for worker 0
+        rows = np.array([1, 5], np.int32)
+        delta = np.zeros((2, 64), np.float32)
+        delta[0, 3], delta[1, 60] = 0.731, -0.292
+        steps = 16
+        for _ in range(steps):
+            table.add_rows(rows, delta, option=AddOption(worker_id=1))
+        buf = np.zeros((8, 64), np.float32)
+        table.get(out=buf)
+        np.testing.assert_allclose(buf[1], steps * delta[0],
+                                   rtol=0, atol=0.02)
+        np.testing.assert_allclose(buf[5], steps * delta[1],
+                                   rtol=0, atol=0.02)
 
     def test_row_get_marks_clean(self, env):
         table = mv.create_matrix_table(6, 2, is_sparse=True)
@@ -347,10 +392,13 @@ class TestDeviceResidentPath:
         np.testing.assert_array_equal(np.asarray(vals2),
                                       2 * np.ones((2, 4), np.float32))
         # Device-mirror ids (the upload-skipping form) and the cached
-        # dirty device vector produce the same result.
+        # dirty device vector produce the same result. The mirror must
+        # be bucket-padded like the host path (compile-per-bucket, not
+        # per distinct k).
+        from multiverso_tpu.updater.engine import pad_ids
         ids_m, vals_m = table.add_get_dirty_device(
             rows, one, option=AddOption(worker_id=1), get_worker=0,
-            row_ids_device=jnp.asarray(rows))
+            row_ids_device=jnp.asarray(pad_ids(rows, 16)))
         np.testing.assert_array_equal(ids_m, rows)
         np.testing.assert_array_equal(np.asarray(vals_m),
                                       3 * np.ones((2, 4), np.float32))

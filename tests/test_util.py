@@ -196,9 +196,27 @@ class TestSparseFilter:
         dense[[3, 50, 99]] = [1.5, -2.0, 3.0]
         blobs, sizes = f.filter_in([dense])
         assert sizes[0] == 100
-        assert blobs[0].size == 6  # 3 pairs
+        # Compact codec frame (float64-pair format removed): 24-byte
+        # header + u32 first idx + 2 u16 gaps + 3 fp32 values = 44 B,
+        # vs 48 B of float64 pairs.
+        assert blobs[0].dtype == np.uint8 and blobs[0].size == 44
         out = f.filter_out(blobs, sizes)
         np.testing.assert_array_equal(out[0], dense)
+
+    def test_lossy_residual_exposed(self):
+        f = SparseFilter(lossy=True)
+        dense = np.zeros(4096, dtype=np.float32)
+        rng = np.random.default_rng(3)
+        hot = rng.choice(4096, 200, replace=False)
+        dense[hot] = rng.standard_normal(200).astype(np.float32)
+        blobs, sizes = f.filter_in([dense])
+        out = f.filter_out(blobs, sizes)[0]
+        residual = f.last_residuals[0]
+        if residual is None:  # heuristic picked a lossless tier
+            np.testing.assert_array_equal(out, dense)
+        else:
+            np.testing.assert_allclose(out + residual, dense,
+                                       rtol=0, atol=1e-5)
 
     def test_dense_passthrough(self):
         f = SparseFilter()
