@@ -9,6 +9,20 @@ echo "== build native (c_api shim) from source =="
 make -C native clean
 make -C native
 
+echo "== collection sanity (no tests silently skipped) =="
+# A collection error under --continue-on-collection-errors silently
+# shrinks the suite; gate on a clean collection pass so a broken import
+# fails CI loudly instead of skipping its whole file.
+python -m pytest tests/ --collect-only -q > /tmp/mv_collect.log 2>&1 \
+    || { cat /tmp/mv_collect.log; echo "FATAL: test collection errors"; \
+         exit 1; }
+
+echo "== fast wire-codec + client-cache subsets =="
+# The two wire-facing suites run first and explicitly: a regression in
+# the codec frames or the versioned cache must name itself, not hide
+# inside the full run's output.
+python -m pytest tests/test_wire_codec.py tests/test_client_cache.py -x -q
+
 echo "== unit + in-process integration tests =="
 # Virtual 8-device CPU mesh (tests/conftest.py forces the platform).
 python -m pytest tests/ -x -q --ignore=tests/test_net_integration.py

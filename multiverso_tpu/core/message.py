@@ -148,13 +148,35 @@ def is_wire_encoded(msg: "Message") -> bool:
     return bool(msg.header[CODEC_SLOT])
 
 
+# Header slot 7 carries the serving table shard's VERSION on replies
+# (client-cache staleness tracking, tables/client_cache.py): servers
+# bump a per-shard counter once per applied Add and stamp every reply.
+# The wire value is version+1 so that 0 — the header default, and what
+# a pre-version peer sends — reads as "unstamped" (-1), never as a real
+# version.
+VERSION_SLOT = 7
+
+
+def stamp_version(reply: "Message", version: int) -> None:
+    reply.header[VERSION_SLOT] = int(version) + 1
+
+
+def reply_version(msg: "Message") -> int:
+    """The shard version stamped on a reply, or -1 when the peer didn't
+    stamp one (legacy build / error reply)."""
+    return int(msg.header[VERSION_SLOT]) - 1
+
+
 # -- Add coalescing (Request_BatchAdd / Reply_BatchAdd) --
 #
 # Batch request layout: blob 0 is an int32 descriptor
 #   [n_sub, table_id_0, msg_id_0, n_blobs_0, ..., table_id_{n-1}, ...]
 # followed by every sub-message's blobs in order. Batch reply layout:
-# blob 0 is int32 [n_sub, table_id_0, msg_id_0, err_0, ...] followed by
-# one utf-8 error-text blob per err_i != 0 (in sub order).
+# blob 0 is int32 [n_sub, table_id_0, msg_id_0, err_0, version_0, ...]
+# followed by one utf-8 error-text blob per err_i != 0 (in sub order);
+# version_i is the shard version after the sub was applied (-1 when the
+# server could not resolve the table), the batched twin of the
+# VERSION_SLOT stamp on per-message replies.
 
 def pack_add_batch(subs: List["Message"]) -> "Message":
     """Coalesce several Request_Add shard messages (same src, same dst)

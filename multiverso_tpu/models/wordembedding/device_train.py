@@ -450,7 +450,10 @@ def _ma_group_fn(mesh, C: int, W: int, K: int, neg_block: int = 1):
     counts [n_devices]. Returns (averaged tables, summed loss, summed
     pairs, advanced per-device keys) — feed the keys back when chaining
     dispatches or every group replays the same draws."""
-    from jax import shard_map
+    try:  # jax >= 0.4.31 top-level export; older: experimental
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     axis = mesh.axis_names[0]
@@ -465,7 +468,11 @@ def _ma_group_fn(mesh, C: int, W: int, K: int, neg_block: int = 1):
         try:
             pcast = functools.partial(jax.lax.pcast, to="varying")
         except AttributeError:  # older jax spells it pvary
-            pcast = jax.lax.pvary
+            pcast = getattr(jax.lax, "pvary", None)
+        if pcast is None:  # pre-0.5 jax: no varying-type system in
+            # shard_map, so the annotation is correctly a no-op
+            def pcast(x, _axis):
+                return x
         emb_in = pcast(emb_in, axis)
         emb_out = pcast(emb_out, axis)
         # Pad each device's LOCAL stream for the banded slices (inside

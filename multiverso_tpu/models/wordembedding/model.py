@@ -41,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import create_kv_table, create_matrix_table
+from ...tables import client_cache
+from ...util.dashboard import monitor
 from .data import CbowBatch, PairBatch
 from .dictionary import Dictionary
 from .huffman import build_huffman
@@ -686,6 +688,14 @@ class PSWord2Vec(Word2Vec):
         self._wc_pending = 0.0
         self._batches_done = 0
         self._pending_pushes: List = []
+        # Pipelined Get prefetch (-max_get_staleness > 0, host path
+        # only): while the device computes step i, step i+1's rows are
+        # prefetched into the client cache, so _prepare's real pull
+        # hits locally or joins the in-flight fetch instead of paying a
+        # fresh wire roundtrip. The device path already keeps the whole
+        # loop in HBM — nothing to hide there.
+        self._use_prefetch = (client_cache.cache_enabled()
+                              and not self._device_path)
 
     def _init_embeddings(self) -> None:
         """No full local matrices: the input table is random-initialized
@@ -791,8 +801,12 @@ class PSWord2Vec(Word2Vec):
     # -- phase 2: wait the pull, dispatch the device step (async) --
     def _launch(self, prep: _Prep) -> _Launched:
         compact = prep.compact
-        self._in_table.wait(prep.mid_in)
-        self._out_table.wait(prep.mid_out)
+        with monitor("PS_GET_STALL"):
+            # The trainer's pull-stall: wire latency NOT hidden by the
+            # pipeline (cache hits and completed prefetches make this
+            # ~zero; the bench's client_cache phase reads it).
+            self._in_table.wait(prep.mid_in)
+            self._out_table.wait(prep.mid_out)
         if self._device_path:
             old_in = self._in_table.take_device_rows()
             old_out = self._out_table.take_device_rows()
@@ -879,15 +893,39 @@ class PSWord2Vec(Word2Vec):
     def train_batch_async(self, batch):
         return jnp.float32(self.train_batch(batch))
 
+    def _prefetched(self, batches):
+        """Double-buffer adapter: prepare batch i+1 and PREFETCH its row
+        sets into the client cache before yielding batch i, so the real
+        pull in ``_prepare`` overlaps the device step instead of
+        serializing behind it (the async twin of the reference's
+        pipelined block protocol, distributed_wordembedding.cpp:203-224
+        — there via double server-side consumer slots, here via the
+        versioned worker cache)."""
+        held = None
+        for batch in batches:
+            compact = batch if isinstance(batch, CompactBatch) \
+                else self.prepare(batch)
+            self._in_table.prefetch_rows_async(compact.rows_in)
+            self._out_table.prefetch_rows_async(compact.rows_out)
+            if held is not None:
+                yield held
+            held = compact
+        if held is not None:
+            yield held
+
     def train_batches(self, iterator) -> Tuple[float, int]:
         """Pipelined loop: batch i+1's row pull is serviced by the server
         actors while batch i's step runs on device and its deltas push
         (ref overlap: distributed_wordembedding.cpp:203-224). Losses
         accumulate as device scalars — one host materialization at the
-        end, no per-batch syncs."""
+        end, no per-batch syncs. With the client cache enabled the loop
+        additionally prefetches batch i+1's rows during batch i's step
+        (see ``_prefetched``)."""
         acc = None
         pairs = 0
         launched: Optional[_Launched] = None
+        if self._use_prefetch:
+            iterator = self._prefetched(iterator)
         for batch in iterator:
             prep = self._prepare(batch)  # async pull in flight
             if launched is not None:

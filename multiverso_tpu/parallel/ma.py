@@ -21,7 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+try:  # jax >= 0.4.31 exports it at the top level
+    from jax import shard_map
+except ImportError:  # older jax: the experimental module is the API
+    from jax.experimental.shard_map import shard_map
 
 from ..runtime.zoo import current_zoo
 from ..sharding import mesh as meshlib

@@ -13,13 +13,15 @@ here is host-side control only, with the arithmetic jit-dispatched.
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 from typing import Deque, List, Optional
 
 import numpy as np
 
 from ..core.blob import Blob
-from ..core.message import Message, MsgType, mark_error, unpack_add_batch
+from ..core.message import (Message, MsgType, mark_error, stamp_version,
+                            unpack_add_batch)
 from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
@@ -45,7 +47,16 @@ class Server(Actor):
     #: threads parked in pxla __call__ forever). One server per process
     #: (the real deployment) never contends; RLock because the sync
     #: server's drain paths re-enter through Server._process_*.
+    #: SCOPED to device-backed tables only (``needs_device_lock``):
+    #: host-only table logic (KV control plane) must not serialize two
+    #: in-process server shards against each other — that regression
+    #: put ps_two_servers at 0.809x of single-server in BENCH_r05.
     _table_lock = threading.RLock()
+    _no_lock = contextlib.nullcontext()
+
+    def _lock_for(self, table):
+        return self._table_lock if getattr(table, "needs_device_lock",
+                                           True) else self._no_lock
 
     def __init__(self, zoo) -> None:
         super().__init__(actors.SERVER, zoo)
@@ -79,9 +90,13 @@ class Server(Actor):
             # actor loop only logs; without this, every server-side CHECK
             # degrades to silent garbage at the caller).
             try:
-                with self._table_lock:
-                    reply.data = \
-                        self._store[msg.table_id].process_get(msg.data)
+                table = self._store[msg.table_id]
+                with self._lock_for(table):
+                    reply.data = table.process_get(msg.data)
+                # Version stamp: the shard state this Get observed
+                # (client-cache freshness anchor). Error replies stay
+                # unstamped — the worker checks the error flag first.
+                stamp_version(reply, table.version)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
                 raise
@@ -93,8 +108,14 @@ class Server(Actor):
         with monitor("SERVER_PROCESS_ADD"):
             reply = msg.create_reply_message()
             try:
-                with self._table_lock:
-                    self._store[msg.table_id].process_add(msg.data)
+                table = self._store[msg.table_id]
+                with self._lock_for(table):
+                    table.process_add(msg.data)
+                # One bump per APPLIED Add; the ack carries the post-add
+                # version so the adder can resolve its self-invalidated
+                # cache slots (read-your-writes).
+                table.version += 1
+                stamp_version(reply, table.version)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
                 raise
@@ -103,8 +124,10 @@ class Server(Actor):
 
     def _process_batch_add(self, msg: Message) -> None:
         """Coalesced adds: apply every sub-add, ack them all in ONE
-        Reply_BatchAdd (descriptor [n, (table_id, msg_id, err)...] +
-        one utf-8 text blob per failed sub). A sub failure must not
+        Reply_BatchAdd (descriptor [n, (table_id, msg_id, err,
+        version)...] + one utf-8 text blob per failed sub; version is
+        the shard version after the sub applied, the batched twin of
+        the per-message VERSION_SLOT stamp). A sub failure must not
         stop the siblings: each waiter still gets its notify, failed
         ones with the error recorded so the caller's wait() raises.
         The reply goes out in EVERY path — a swallowed reply would
@@ -118,8 +141,10 @@ class Server(Actor):
             err_blobs: List[Blob] = []
 
             def record(table_id: int, msg_id: int,
-                       exc: Optional[BaseException]) -> None:
-                desc.extend((table_id, msg_id, 0 if exc is None else 1))
+                       exc: Optional[BaseException],
+                       version: int = -1) -> None:
+                desc.extend((table_id, msg_id,
+                             0 if exc is None else 1, version))
                 desc[0] += 1
                 if exc is not None:
                     text = f"{type(exc).__name__}: {exc}" \
@@ -150,13 +175,19 @@ class Server(Actor):
                     return
                 for sub in subs:
                     try:
-                        with self._table_lock:
-                            self._store[sub.table_id].process_add(
-                                sub.data)
-                        record(sub.table_id, sub.msg_id, None)
+                        table = self._store[sub.table_id]
+                        with self._lock_for(table):
+                            table.process_add(sub.data)
+                        table.version += 1
+                        record(sub.table_id, sub.msg_id, None,
+                               table.version)
                     except Exception as exc:  # noqa: BLE001 - per-sub
                         # failure travels back in the batch ack
-                        record(sub.table_id, sub.msg_id, exc)
+                        try:
+                            at = self._store[sub.table_id].version
+                        except Exception:  # noqa: BLE001 - bad table id
+                            at = -1
+                        record(sub.table_id, sub.msg_id, exc, at)
                         log.error("server: batched add failed "
                                   "(error travels in the batch ack)")
                         import traceback

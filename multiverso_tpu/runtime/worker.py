@@ -25,11 +25,13 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..core.message import (Message, MsgType, pack_add_batch, take_error)
+from ..core.message import (Message, MsgType, pack_add_batch,
+                            reply_version, take_error)
 from ..util.configure import define_bool, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from .actor import Actor
+from .server import Server
 
 define_bool("coalesce_adds", True,
             "batch pending Add shards to the same server into one wire "
@@ -102,7 +104,20 @@ class Worker(Actor):
     def _partition_and_send(self, msg: Message, msg_type: MsgType) -> None:
         table = self._cache[msg.table_id]
         try:
-            partitions = table.partition(msg.data, msg_type)
+            # Partitions of DEVICE-carrying requests dispatch eager
+            # device ops (per-server delta slices). Those must
+            # serialize on the same process-wide lock as server table
+            # logic: a worker actor's eager dispatch interleaving a
+            # sibling zoo's server jit deadlocks XLA's CPU runtime
+            # exactly like the server-vs-server case the lock was
+            # introduced for (observed: stack parked in partition's
+            # device slice while a server holds a jitted gather).
+            # Pure-host partitions — the wire hot path — skip the lock
+            # entirely, mirroring needs_device_lock on the server side.
+            lock = Server._table_lock \
+                if any(b.on_device for b in msg.data) else Server._no_lock
+            with lock:
+                partitions = table.partition(msg.data, msg_type)
         except Exception as exc:
             # Record the failure on the request and release the caller's
             # waiter — wait() raises instead of returning 'success' over
@@ -168,6 +183,11 @@ class Worker(Actor):
         with monitor("WORKER_COALESCE_FLUSH"):
             self.send_to(actors.COMMUNICATOR, pack_add_batch(staged))
 
+    def _reply_server_id(self, msg: Message) -> int:
+        """Server id of the shard a reply came from (version stamps are
+        per server shard)."""
+        return self._zoo.rank_to_server_id(msg.src)
+
     # ref: src/worker.cpp:78-84
     def _process_reply_get(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
@@ -181,7 +201,21 @@ class Worker(Actor):
             if error is not None:
                 table.fail(msg.msg_id, error, count=False)
             else:
-                table.process_reply_get(msg.data)
+                # Reply context (origin server, version stamp, request
+                # id): lets the table attribute the payload to a shard
+                # version for the client cache and route prefetch
+                # replies — single worker thread, so plain attributes.
+                table._begin_reply(self._reply_server_id(msg),
+                                   reply_version(msg), msg.msg_id)
+                try:
+                    # NOT under the table lock: reply handling may
+                    # MATERIALIZE device payloads (host-buffer gets),
+                    # which blocks on server-produced computations —
+                    # holding the lock across that wait starves the
+                    # producing side.
+                    table.process_reply_get(msg.data)
+                finally:
+                    table._end_reply()
         except Exception as exc:
             table.fail(msg.msg_id, f"reply handling failed: {exc}",
                        count=False)
@@ -192,6 +226,10 @@ class Worker(Actor):
     # ref: src/worker.cpp:86-88
     def _process_reply_add(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
+        # The piggybacked version bump must land BEFORE the notify: the
+        # adder's completion callback reads the tracker to resolve its
+        # self-invalidated cache slots (read-your-writes).
+        table.note_version(self._reply_server_id(msg), reply_version(msg))
         error = take_error(msg)
         if error is not None:
             table.fail(msg.msg_id, error, count=False)
@@ -218,12 +256,31 @@ class Worker(Actor):
                 f"{error}")
             return
         desc = msg.data[0].as_array(np.int32)
+        if desc.size != 1 + 4 * int(desc[0]):
+            # A stride mismatch is a pre-version peer's stride-3 ack
+            # (or frame corruption): parsing it would notify the WRONG
+            # requests' waiters and crash mid-loop, stranding the rest.
+            # Same escape hatch as the whole-batch-error path above —
+            # loud abort over silent ack misrouting.
+            from ..util import log
+            log.error("worker: batch ack descriptor stride mismatch "
+                      "(%d ints for %d subs) — mixed-build coalesced "
+                      "cluster? (docs/WIRE_FORMAT.md)", desc.size,
+                      int(desc[0]))
+            self.abort_tables(
+                f"unparseable batch ack from rank {msg.src}: "
+                f"{desc.size} descriptor ints for {int(desc[0])} subs")
+            return
         err_blobs = msg.data[1:]
         err_idx = 0
+        server_id = self._reply_server_id(msg)
         for i in range(int(desc[0])):
-            table_id, msg_id, failed = (int(v)
-                                        for v in desc[1 + 3 * i:4 + 3 * i])
+            table_id, msg_id, failed, version = (
+                int(v) for v in desc[1 + 4 * i:5 + 4 * i])
             table = self._cache[table_id]
+            # Per-sub version stamp, noted before the notify (the
+            # adder's cache-resolution callback reads it).
+            table.note_version(server_id, version)
             if failed:
                 text = bytes(err_blobs[err_idx].as_array(np.uint8)) \
                     .decode(errors="replace") \

@@ -1,0 +1,475 @@
+"""Worker-side versioned parameter cache (the client cache).
+
+Extension over the reference: Multiverso's workers re-issue a full
+server roundtrip for every ``Get`` even when the rows were fetched one
+step earlier and nothing changed (ref: src/worker.cpp:30-51 always
+partitions and sends). Over the tunneled bench transport a dispatch
+roundtrip costs ~92 ms, and the wordembedding workload's power-law row
+popularity (SparCML's observation, PAPERS.md) means a small hot-row
+cache absorbs most of that traffic.
+
+Versioning model
+----------------
+* every ``ServerTable`` shard keeps a monotonically increasing
+  ``version``, bumped once per successfully applied Add (the server
+  actor owns the bump, runtime/server.py);
+* Get/Add/BatchAdd replies carry the serving shard's version
+  (``core.message.VERSION_SLOT`` on per-message replies, a descriptor
+  column on batch acks);
+* each worker table tracks, per server shard, the LATEST version it has
+  observed (``VersionTracker``);
+* a cache entry fetched at version ``v`` may serve a Get only while
+  ``v >= latest_observed - max_get_staleness``.
+
+``-max_get_staleness=0`` (the default) disables the cache outright —
+every Get takes today's wire path, byte-identical. BSP sync mode
+force-disables it regardless of the flag: a locally served Get is a Get
+the sync server's vector clocks never count, which would break the
+every-i-th-Get-sees-every-i-th-Add contract.
+
+Read-your-writes
+----------------
+The staleness bound alone would let a worker read back a PRE-write value
+of a row it just pushed a delta to. So issuing an Add immediately
+*blocks* the touched slots (they neither serve nor accept stores), and
+the Add's ack — which carries the post-add version — resolves the block
+and raises the slots' floor to the latest observed version: only values
+fetched at-or-after the worker's own write can serve again. This is the
+piggybacked self-invalidation the Add-ack version stamp exists for.
+
+Staleness is measured against the latest version THIS worker has
+observed, not the server's true head: a worker that never hears from the
+server (no Gets, no Add acks) cannot age its entries. The wire-path
+population of the cache (every real Get refreshes entries AND the
+tracker) keeps the two converged in any workload that misses
+occasionally; workloads needing a hard recency guarantee set the bound
+to 0 for the critical read or call the table's uncached device/sync
+paths.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..util.configure import define_int, get_flag
+from ..util.dashboard import count
+
+define_int("max_get_staleness", 0,
+           "client-side parameter-cache staleness bound, in server-shard "
+           "versions (one version = one applied Add): a cached Get may "
+           "serve while its fetch version is within this many versions "
+           "of the latest version observed from the owning shard. "
+           "0 (default) disables the cache; BSP sync mode force-disables "
+           "it (a locally served Get would bypass the vector clocks)")
+define_int("client_cache_rows", 65536,
+           "row capacity of the matrix client cache (oldest entries "
+           "evicted past this; bounds worker memory at rows * num_col * "
+           "itemsize)")
+
+#: Dashboard counter names (util/dashboard.py `count`).
+HIT = "CLIENT_CACHE_HIT"
+MISS = "CLIENT_CACHE_MISS"
+JOIN = "CLIENT_CACHE_JOIN"
+PREFETCH = "CLIENT_CACHE_PREFETCH"
+
+
+def staleness_bound() -> int:
+    """The active staleness bound; 0 = cache disabled. Read at table
+    construction time (matching ``-sparse_compress`` and friends)."""
+    if bool(get_flag("sync", False)):
+        return 0
+    try:
+        bound = int(get_flag("max_get_staleness", 0))
+    except (TypeError, ValueError):
+        return 0
+    return max(bound, 0)
+
+
+def cache_enabled() -> bool:
+    return staleness_bound() > 0
+
+
+def place_rows(keys: np.ndarray, values, req: np.ndarray, out) -> None:
+    """Vectorized subset placement: every position of ``req`` whose row
+    id appears in ``keys`` receives that id's row of ``values``;
+    positions for absent ids are left untouched. Shared by the cache's
+    partial-hit fill and the table reply path — ``req`` may repeat ids
+    thousands of times (power-of-two padded row sets), so per-position
+    Python loops are pathological here."""
+    if len(keys) == 0 or len(req) == 0:
+        return
+    sorter = np.argsort(keys, kind="stable")
+    sorted_keys = keys[sorter]
+    slot = np.searchsorted(sorted_keys, req)
+    slot = np.minimum(slot, sorted_keys.size - 1)
+    hit = sorted_keys[slot] == req
+    out[hit] = values[sorter[slot[hit]]]
+
+
+class VersionTracker:
+    """Latest table-shard version observed per server id (-1 before any
+    observation). Fed by the worker actor from reply version stamps."""
+
+    def __init__(self) -> None:
+        self._latest: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def note(self, server_id: int, version: int) -> None:
+        if version < 0:
+            return
+        with self._lock:
+            if version > self._latest.get(server_id, -1):
+                self._latest[server_id] = version
+
+    def latest(self, server_id: int) -> int:
+        return self._latest.get(server_id, -1)
+
+    def known_servers(self) -> List[int]:
+        with self._lock:
+            return list(self._latest)
+
+
+class RowCache:
+    """Row-granular cache for dense matrix worker tables.
+
+    Every public method is thread-safe: lookups/invalidation run on the
+    requester's thread, stores and add-resolution on the worker actor's
+    reply thread.
+    """
+
+    def __init__(self, bound: int, server_of: Callable, num_servers: int,
+                 tracker: VersionTracker,
+                 capacity: Optional[int] = None) -> None:
+        self._bound = int(bound)
+        self._server_of = server_of  # vectorized row ids -> server ids
+        self._num_servers = int(num_servers)
+        self._tracker = tracker
+        self._capacity = int(capacity if capacity is not None
+                             else get_flag("client_cache_rows"))
+        self._lock = threading.Lock()
+        self._rows: Dict[int, Tuple[int, np.ndarray]] = {}
+        self._floor: Dict[int, int] = {}      # per-row min fetch version
+        self._floor_all: Dict[int, int] = {}  # per-server floor
+        self._pending: Dict[int, int] = {}    # row -> outstanding own-adds
+        self._pending_all = 0                 # whole-table own-adds
+        self.hits = 0        # full-local Gets (no wire message at all)
+        self.misses = 0      # Gets that needed the wire for >=1 row
+        self.rows_hit = 0    # row-granular accounting across both
+        self.rows_missed = 0
+        #: test hook: fn(row, entry_version, latest_observed, bound),
+        #: called under the cache lock for every row actually SERVED.
+        self.on_hit = None
+
+    # -- freshness core (caller holds the lock) --
+    def _fresh(self, row: int, sid: int,
+               record: bool = True) -> Optional[np.ndarray]:
+        if self._pending_all or self._pending.get(row):
+            return None
+        ent = self._rows.get(row)
+        if ent is None:
+            return None
+        version, value = ent
+        if version < max(self._floor.get(row, -1),
+                         self._floor_all.get(sid, -1)):
+            return None
+        latest = self._tracker.latest(sid)
+        if latest - version > self._bound:
+            return None
+        if record and self.on_hit is not None:
+            self.on_hit(row, version, latest, self._bound)
+        return value
+
+    # -- read side --
+    def missing_of(self, row_ids: np.ndarray) -> np.ndarray:
+        """The sorted unique requested rows that would NOT hit (no
+        copies, no counter bumps) — the prefetch planning check; an
+        empty result means full coverage."""
+        uniq = np.unique(row_ids)
+        sids = self._server_of(uniq)
+        with self._lock:
+            return np.asarray(
+                [int(r) for r, s in zip(uniq, sids)
+                 if self._fresh(int(r), int(s), record=False) is None],
+                dtype=np.int32)
+
+    def fetch_into(self, row_ids: np.ndarray, out: np.ndarray,
+                   count_stats: bool = True) -> np.ndarray:
+        """Partial-hit fill: copy every fresh row into its requested
+        positions (duplicates welcome) and return the sorted unique
+        MISSING rows — empty = full local hit. The caller fetches only
+        the missing set over the wire; its reply placement fills the
+        remaining positions (reply keys are a subset of the request's,
+        which the placement path already supports). The join-completion
+        re-serve passes ``count_stats=False`` so one logical Get
+        contributes exactly one hit-or-miss."""
+        uniq = np.unique(row_ids)
+        sids = self._server_of(uniq)
+        fresh_vals: List[np.ndarray] = []
+        fresh_keys: List[int] = []
+        missing: List[int] = []
+        with self._lock:
+            for r, s in zip(uniq, sids):
+                v = self._fresh(int(r), int(s),
+                                record=count_stats)
+                if v is None:
+                    missing.append(int(r))
+                else:
+                    fresh_keys.append(int(r))
+                    fresh_vals.append(v)
+            if count_stats:
+                self.rows_hit += len(fresh_keys)
+                self.rows_missed += len(missing)
+                if missing:
+                    self.misses += 1
+                else:
+                    self.hits += 1
+        if count_stats:
+            count(MISS if missing else HIT)
+        if fresh_keys:
+            place_rows(np.asarray(fresh_keys, dtype=np.int64),
+                       np.stack(fresh_vals), row_ids, out)
+        return np.asarray(missing, dtype=np.int32)
+
+    # -- write side (worker actor reply thread) --
+    def store(self, row_ids: np.ndarray, values: np.ndarray,
+              version: int, server_id: int) -> None:
+        """Record one reply shard's rows at the version it was served.
+        Slots blocked by an outstanding own-add, or whose floor exceeds
+        the fetch version, are skipped — never silently resurrected."""
+        if version < 0:  # unstamped legacy peer
+            return
+        with self._lock:
+            if self._pending_all:
+                return
+            if version < self._floor_all.get(int(server_id), -1):
+                return
+            for i, r in enumerate(row_ids):
+                r = int(r)
+                if self._pending.get(r):
+                    continue
+                floor = self._floor.get(r, -1)
+                if version < floor:
+                    continue
+                # Replies per server connection arrive version-ordered
+                # (FIFO socket, monotonic server counter), so a passed
+                # floor never needs re-checking.
+                self._floor.pop(r, None)
+                self._rows[r] = (version, np.array(values[i], copy=True))
+            while len(self._rows) > self._capacity:
+                self._rows.pop(next(iter(self._rows)))
+
+    # -- own-add self-invalidation --
+    def begin_add(self, row_ids: Optional[np.ndarray] = None):
+        """Block the slots an own Add is about to dirty (None = whole
+        table). Returns a token for ``finish_add``."""
+        if row_ids is None:
+            with self._lock:
+                self._pending_all += 1
+            return (None, None)
+        rows = np.unique(np.asarray(row_ids,
+                                    dtype=np.int64).reshape(-1))
+        sids = self._server_of(rows)
+        rows = [int(r) for r in rows]
+        with self._lock:
+            for r in rows:
+                self._pending[r] = self._pending.get(r, 0) + 1
+                self._rows.pop(r, None)
+        return (rows, [int(s) for s in sids])
+
+    def finish_add(self, token) -> None:
+        """Resolve a ``begin_add`` once its ack arrived: unblock the
+        slots and raise their floor to the latest observed version (the
+        ack was noted before this runs), so only values fetched at-or-
+        after the write serve again."""
+        rows, sids = token
+        with self._lock:
+            if rows is None:
+                self._pending_all -= 1
+                if self._pending_all == 0:
+                    self._rows.clear()
+                    for sid in range(self._num_servers):
+                        self._floor_all[sid] = max(
+                            self._floor_all.get(sid, -1),
+                            self._tracker.latest(sid))
+                return
+            for r, s in zip(rows, sids):
+                remaining = self._pending.get(r, 0) - 1
+                if remaining > 0:
+                    self._pending[r] = remaining
+                else:
+                    self._pending.pop(r, None)
+                self._floor[r] = max(self._floor.get(r, -1),
+                                     self._tracker.latest(int(s)))
+
+    @property
+    def stats(self) -> Dict[str, float]:
+        total = self.hits + self.misses
+        rows_total = self.rows_hit + self.rows_missed
+        return {"hits": self.hits, "misses": self.misses,
+                "hit_rate": self.hits / total if total else 0.0,
+                "rows_hit": self.rows_hit,
+                "rows_missed": self.rows_missed,
+                "row_hit_rate": self.rows_hit / rows_total
+                if rows_total else 0.0,
+                "rows": len(self._rows)}
+
+
+class BlobCache:
+    """Whole-shard cache for Array worker tables: one entry per server
+    shard; a hit requires EVERY shard fresh (array Gets are whole-table)."""
+
+    def __init__(self, bound: int, num_servers: int,
+                 tracker: VersionTracker) -> None:
+        self._bound = int(bound)
+        self._num_servers = int(num_servers)
+        self._tracker = tracker
+        self._lock = threading.Lock()
+        self._shards: Dict[int, Tuple[int, np.ndarray]] = {}
+        self._floor: Dict[int, int] = {}
+        self._pending = 0
+        self.hits = 0
+        self.misses = 0
+        self.on_hit = None  # fn(server_id, entry_version, latest, bound)
+
+    def fresh_all(self) -> bool:
+        """Counter-free freshness probe (the prefetch planning check —
+        hit/miss accounting must reflect Get serving only)."""
+        with self._lock:
+            if self._pending:
+                return False
+            for sid in range(self._num_servers):
+                ent = self._shards.get(sid)
+                if ent is None:
+                    return False
+                version, _ = ent
+                if version < self._floor.get(sid, -1) \
+                        or self._tracker.latest(sid) - version \
+                        > self._bound:
+                    return False
+        return True
+
+    def fetch_all(self) -> Optional[Dict[int, np.ndarray]]:
+        with self._lock:
+            if self._pending:
+                out = None
+            else:
+                out = {}
+                for sid in range(self._num_servers):
+                    ent = self._shards.get(sid)
+                    if ent is None:
+                        out = None
+                        break
+                    version, value = ent
+                    if version < self._floor.get(sid, -1):
+                        out = None
+                        break
+                    latest = self._tracker.latest(sid)
+                    if latest - version > self._bound:
+                        out = None
+                        break
+                    if self.on_hit is not None:
+                        self.on_hit(sid, version, latest, self._bound)
+                    out[sid] = value
+            if out is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+        count(HIT if out is not None else MISS)
+        return out
+
+    def store(self, server_id: int, values: np.ndarray,
+              version: int) -> None:
+        if version < 0:
+            return
+        with self._lock:
+            if self._pending:
+                return
+            if version < self._floor.get(int(server_id), -1):
+                return
+            self._floor.pop(int(server_id), None)
+            self._shards[int(server_id)] = (version,
+                                            np.array(values, copy=True))
+
+    def begin_add(self) -> None:
+        with self._lock:
+            self._pending += 1
+            self._shards.clear()
+
+    def finish_add(self) -> None:
+        with self._lock:
+            self._pending -= 1
+            if self._pending == 0:
+                for sid in range(self._num_servers):
+                    self._floor[sid] = max(self._floor.get(sid, -1),
+                                           self._tracker.latest(sid))
+
+
+class SnapshotCache:
+    """Request-granular snapshot cache for KV worker tables: keyed by
+    the exact requested key bytes; an entry records the version of every
+    server shard that contributed."""
+
+    def __init__(self, bound: int, tracker: VersionTracker,
+                 capacity: int = 256) -> None:
+        self._bound = int(bound)
+        self._tracker = tracker
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, Tuple[Dict[int, int], dict]] = {}
+        self._floor: Dict[int, int] = {}
+        self._pending = 0
+        self.hits = 0
+        self.misses = 0
+
+    def fetch(self, key: bytes, server_ids) -> Optional[dict]:
+        with self._lock:
+            snap = None
+            if not self._pending:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    versions, values = ent
+                    ok = True
+                    for sid in server_ids:
+                        sid = int(sid)
+                        v = versions.get(sid)
+                        if (v is None or v < self._floor.get(sid, -1)
+                                or self._tracker.latest(sid) - v
+                                > self._bound):
+                            ok = False
+                            break
+                    if ok:
+                        snap = dict(values)
+            if snap is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+        count(HIT if snap is not None else MISS)
+        return snap
+
+    def store(self, key: bytes, versions: Dict[int, int],
+              values: dict) -> None:
+        with self._lock:
+            if self._pending:
+                return
+            for sid, v in versions.items():
+                if v < 0 or v < self._floor.get(int(sid), -1):
+                    return
+            self._entries[key] = (dict(versions), dict(values))
+            while len(self._entries) > self._capacity:
+                self._entries.pop(next(iter(self._entries)))
+
+    def begin_add(self) -> None:
+        with self._lock:
+            self._pending += 1
+            self._entries.clear()
+
+    def finish_add(self) -> None:
+        with self._lock:
+            self._pending -= 1
+            if self._pending == 0:
+                for sid in self._tracker.known_servers():
+                    self._floor[sid] = max(self._floor.get(sid, -1),
+                                           self._tracker.latest(sid))

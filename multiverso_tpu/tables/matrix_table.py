@@ -31,6 +31,7 @@ whole-table ops are single fused device ops.
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -39,6 +40,9 @@ import numpy as np
 
 from ..core.blob import Blob, is_device_array
 from ..core.message import MsgType
+from ..util.dashboard import count as count_event
+from . import client_cache
+from .client_cache import RowCache
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, GetOption, UpdateEngine, create_rule
 from ..updater.engine import bucket_size, pad_ids
@@ -240,6 +244,31 @@ class MatrixWorker(WorkerTable):
         self._device_shards: Optional[Dict[int, object]] = None
         self._device_shard_ids: Optional[Dict[int, np.ndarray]] = None
         self._mirror_verified = False  # -verify_device_ids: once per table
+        # Client cache (-max_get_staleness > 0): row-granular, DENSE
+        # host-path row Gets only. Sparse tables are excluded — their
+        # dirty-row protocol IS a server-tracked staleness cache, and a
+        # client copy on top would double-apply the bookkeeping. Device
+        # replies (live jax.Arrays) bypass too: the host cache cannot
+        # hold them without forcing a device->host copy per hit.
+        bound = client_cache.staleness_bound()
+        self._row_cache: Optional[RowCache] = None
+        if bound > 0 and not self.is_sparse:
+            self._row_cache = RowCache(
+                bound,
+                lambda rows: np.minimum(rows // self._row_length,
+                                        self._num_server - 1),
+                self._num_server, self._version_tracker)
+        # In-flight prefetch registry (+ dedup/join): msg_id -> sorted
+        # unique ids being fetched; _pf_by_key dedups identical
+        # prefetches; _pf_joined holds Gets deferred onto an in-flight
+        # prefetch (served from the cache — or forwarded to the wire —
+        # when it completes). Guarded by _pf_lock: prefetches/joins
+        # issue on the requester's thread, completion runs on the
+        # worker actor's.
+        self._pf_lock = threading.Lock()
+        self._pf_rows: Dict[int, np.ndarray] = {}
+        self._pf_by_key: Dict[bytes, int] = {}
+        self._pf_joined: Dict[int, List] = {}
 
     def _check_row_ids(self, row_ids: np.ndarray) -> None:
         """Fail fast in the CALLER on out-of-range ids. partition() runs
@@ -287,7 +316,99 @@ class MatrixWorker(WorkerTable):
         # every requested position gets its id's row.
         self._dest_rows = row_ids
         self._device_shards = None
+        if self._row_cache is not None:
+            # Partial-hit serve: fresh rows fill their positions
+            # locally; only the MISSING unique rows go to the wire (the
+            # reply placement already handles subset keys). A fully
+            # fresh request never leaves the process.
+            missing = self._row_cache.fetch_into(row_ids, out)
+            if missing.size == 0:
+                return self._local_done()
+            # Dedup: missing rows already being fetched by an in-flight
+            # prefetch — defer onto its completion instead of issuing a
+            # second wire message for the same rows.
+            joined = self._join_inflight(missing, row_ids, out)
+            if joined is not None:
+                return joined
+            return self._request_get(Blob(missing.view(np.uint8)))
         return self._request_get(Blob(row_ids.view(np.uint8)))
+
+    # -- client-cache prefetch + in-flight Get dedup --
+    def prefetch_rows_async(self, row_ids) -> int:
+        """Warm the client cache for ``row_ids`` without touching the
+        one-Get-in-flight destination registers: the reply routes into
+        the cache, so a later ``get_rows`` for (a subset of) these rows
+        hits locally or joins the in-flight fetch. Double-buffering
+        trainers call this for step i+1's rows while step i computes,
+        overlapping wire latency with device work. Returns a request id
+        (``wait`` is optional — the trainer usually never waits).
+        No-op when the cache is disabled (``-max_get_staleness=0`` or
+        BSP sync mode, where an extra Get would desync vector clocks)."""
+        if self._row_cache is None:
+            return self._local_done()
+        rows = np.unique(np.ascontiguousarray(
+            row_ids, dtype=np.int32).reshape(-1))
+        self._check_row_ids(rows)
+        # Fetch only what the cache is actually missing — prefetching
+        # already-fresh rows would waste the wire it exists to save.
+        rows = self._row_cache.missing_of(rows)
+        if rows.size == 0:
+            return self._local_done()
+        key = rows.tobytes()
+        with self._pf_lock:
+            existing = self._pf_by_key.get(key)
+            if existing is not None:
+                return existing  # identical prefetch already in flight
+            msg_id = self._new_request()
+            self._pf_rows[msg_id] = rows
+            self._pf_by_key[key] = msg_id
+            # Registered BEFORE the send: the completion sweep must be
+            # able to find this prefetch however fast the reply lands.
+            self.add_completion(msg_id, self._on_prefetch_done)
+        count_event(client_cache.PREFETCH)
+        self._send_request(MsgType.Request_Get,
+                           [Blob(rows.view(np.uint8))], msg_id)
+        return msg_id
+
+    def _join_inflight(self, missing: np.ndarray, row_ids: np.ndarray,
+                       out: np.ndarray) -> Optional[int]:
+        """If an in-flight prefetch covers every MISSING row, defer
+        this Get onto it: completion re-serves from the cache, fetching
+        over the wire only what still isn't there. Either way the
+        returned id completes."""
+        with self._pf_lock:
+            if not self._pf_rows:
+                return None
+            for pf_id, pf_rows in self._pf_rows.items():
+                if np.isin(missing, pf_rows).all():
+                    msg_id = self._new_request()
+                    self._pf_joined.setdefault(pf_id, []).append(
+                        (msg_id, row_ids, out))
+                    count_event(client_cache.JOIN)
+                    return msg_id
+        return None
+
+    def _on_prefetch_done(self, pf_id: int) -> None:
+        """Prefetch completion (worker actor thread): retire the
+        registry entry and settle every joined Get — from the cache for
+        whatever landed/survived, forwarding a wire request only for
+        rows still missing (invalidation raced the prefetch)."""
+        with self._pf_lock:
+            rows = self._pf_rows.pop(pf_id, None)
+            if rows is not None:
+                self._pf_by_key.pop(rows.tobytes(), None)
+            joined = self._pf_joined.pop(pf_id, [])
+        for msg_id, req_rows, out in joined:
+            # count_stats=False: the joined Get already counted its
+            # miss at issue time — the re-serve must not double-count.
+            missing = self._row_cache.fetch_into(req_rows, out,
+                                                 count_stats=False)
+            if missing.size == 0:
+                self.notify(msg_id)
+            else:
+                self._send_request(MsgType.Request_Get,
+                                   [Blob(missing.view(np.uint8))],
+                                   msg_id)
 
     def get_rows_device(self, row_ids):
         """Device-resident row pull: returns ``[k, num_col]`` as a
@@ -424,7 +545,10 @@ class MatrixWorker(WorkerTable):
                  + [Blob(s) for s in segments]
                  + [Blob(d) for d in deltas]
                  + [self._option_blob(option)])
-        return self.request_async_raw(MsgType.Request_Add, blobs)
+        tok = self._cache_begin_add(None)  # device ids: block globally
+        mid = self.request_async_raw(MsgType.Request_Add, blobs)
+        self._cache_resolve_on(mid, tok)
+        return mid
 
     def take_device_row_parts(self):
         """The raw per-server reply shards of the last device get
@@ -458,9 +582,25 @@ class MatrixWorker(WorkerTable):
             delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
         CHECK(int(np.prod(delta.shape)) == self.num_row * self.num_col,
               "bad delta size")
-        return self.add_async_raw(Blob(_ALL_KEY.view(np.uint8)),
-                                  Blob(delta),
-                                  self._option_blob(option))
+        tok = self._cache_begin_add(None)
+        mid = self.add_async_raw(Blob(_ALL_KEY.view(np.uint8)),
+                                 Blob(delta),
+                                 self._option_blob(option))
+        self._cache_resolve_on(mid, tok)
+        return mid
+
+    def _cache_begin_add(self, row_ids: Optional[np.ndarray]):
+        """Block the client-cache slots this Add dirties (None = whole
+        table) until its ack resolves them — read-your-writes."""
+        if self._row_cache is None:
+            return None
+        return self._row_cache.begin_add(row_ids)
+
+    def _cache_resolve_on(self, msg_id: int, token) -> None:
+        if token is not None:
+            self.add_completion(
+                msg_id,
+                lambda _mid, tok=token: self._row_cache.finish_add(tok))
 
     def add_rows(self, row_ids, delta,
                  option: Optional[AddOption] = None) -> None:
@@ -492,8 +632,13 @@ class MatrixWorker(WorkerTable):
             CHECK(tuple(delta.shape) ==
                   tuple(row_ids.shape) + (self.num_col,),
                   "bad device delta shape")
-            return self.add_async_raw(Blob(row_ids), Blob(delta),
-                                      self._option_blob(option))
+            # Device-resident ids cannot be enumerated without a host
+            # sync — block the whole cache until the ack.
+            tok = self._cache_begin_add(None)
+            mid = self.add_async_raw(Blob(row_ids), Blob(delta),
+                                     self._option_blob(option))
+            self._cache_resolve_on(mid, tok)
+            return mid
         row_ids = np.ascontiguousarray(row_ids, dtype=np.int32).reshape(-1)
         self._check_row_ids(row_ids)
         if self._one_bit or self._lossy:
@@ -506,9 +651,12 @@ class MatrixWorker(WorkerTable):
             delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
         CHECK(int(np.prod(delta.shape)) == row_ids.size * self.num_col,
               "bad delta size")
-        return self.add_async_raw(Blob(row_ids.view(np.uint8)),
-                                  Blob(delta),
-                                  self._option_blob(option))
+        tok = self._cache_begin_add(row_ids)
+        mid = self.add_async_raw(Blob(row_ids.view(np.uint8)),
+                                 Blob(delta),
+                                 self._option_blob(option))
+        self._cache_resolve_on(mid, tok)
+        return mid
 
     def _option_blob(self, option: Optional[AddOption]) -> Blob:
         if option is None:
@@ -817,6 +965,20 @@ class MatrixWorker(WorkerTable):
 
     # -- replies (ref: matrix_table.cpp:317-341) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
+        if (self._reply_msg_id >= 0
+                and self._pf_rows.get(self._reply_msg_id) is not None):
+            # Prefetch reply shard: one server's [keys, values] segment
+            # routes into the cache ONLY — the destination registers
+            # belong to whatever real Get may be concurrently in
+            # flight. (Prefetches are dense host row Gets, never codec-
+            # compressed or device-resident.)
+            keys = reply_blobs[0].as_array(np.int32)
+            values = reply_blobs[1].as_array(self.dtype).reshape(
+                keys.size, self.num_col)
+            if self._row_cache is not None:
+                self._row_cache.store(keys, values, self._reply_version,
+                                      self._reply_server)
+            return
         if reply_blobs[0].on_device:
             # Device-key reply: values arrive shaped
             # row_ids.shape + (num_col,), still in HBM — keyed by the
@@ -874,24 +1036,26 @@ class MatrixWorker(WorkerTable):
                   "format was removed (docs/WIRE_FORMAT.md)")
             values = reply_blobs[1].as_array(self.dtype).reshape(
                 keys.size, self.num_col)
+        if self._row_cache is not None and self._dest_rows is not None:
+            # Wire-path population: every real row Get refreshes the
+            # cache (and, via the reply context, the version tracker) —
+            # prefetch is an accelerant, not a requirement, for hits.
+            self._row_cache.store(keys, values, self._reply_version,
+                                  self._reply_server)
         if self._dest_rows is None:
             # Sparse whole-table get: dirty rows land at their global index.
             self._dest[keys] = values
         else:
             # Vectorized placement: every requested position whose row id
             # appears in THIS reply shard gets that row's value (a shard
-            # carries one server's key subset; positions for other servers'
-            # keys are left for their shards). Requests may repeat ids —
-            # power-of-two padded row sets repeat the last id thousands of
-            # times, so per-position Python loops go quadratic and a single
-            # reply can burn minutes.
-            req = self._dest_rows
-            sorter = np.argsort(keys, kind="stable")
-            sorted_keys = keys[sorter]
-            slot = np.searchsorted(sorted_keys, req)
-            slot = np.minimum(slot, sorted_keys.size - 1)
-            hit = sorted_keys[slot] == req
-            self._dest[hit] = values[sorter[slot[hit]]]
+            # carries one server's key subset — possibly only the cache-
+            # missing rows of a partial hit; other positions are left
+            # for sibling shards or were cache-filled). Requests may
+            # repeat ids — power-of-two padded row sets repeat the last
+            # id thousands of times, so per-position Python loops go
+            # quadratic and a single reply can burn minutes.
+            client_cache.place_rows(keys, values, self._dest_rows,
+                                    self._dest)
 
 
 class MatrixServer(ServerTable):

@@ -20,14 +20,21 @@ state for checkpointing (ref: include/multiverso/table_interface.h:61-75).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..core.blob import Blob
 from ..core.message import Message, MsgType
 from ..runtime import actor as actors
 from ..runtime.zoo import current_zoo
+from ..util import log
 from ..util.dashboard import monitor
 from ..util.waiter import Waiter
+from .client_cache import VersionTracker
+
+#: Completed-request errors retained for late ``wait`` calls. Beyond
+#: this, the oldest completed entries are reaped — fire-and-forget async
+#: requests that fail are otherwise a slow leak over a long run.
+_MAX_RETAINED_ERRORS = 128
 
 
 class TableRequestError(RuntimeError):
@@ -47,6 +54,15 @@ class WorkerTable:
         self._waitings: Dict[int, Waiter] = {}
         self._errors: Dict[int, str] = {}
         self._mutex = threading.Lock()
+        # Client-cache plumbing: per-server latest-version tracking and
+        # the reply context the worker actor sets around
+        # process_reply_get (server id, version stamp, request id) so
+        # subclasses can attribute replies without a signature change.
+        self._version_tracker = VersionTracker()
+        self._on_complete: Dict[int, List[Callable]] = {}
+        self._reply_server = -1
+        self._reply_version = -1
+        self._reply_msg_id = -1
 
     # -- public sync API (ref: src/table.cpp:29-38) --
     def get_raw(self, keys: Blob, extra: Sequence[Blob] = ()) -> None:
@@ -83,11 +99,27 @@ class WorkerTable:
         table subclass's ``partition`` defines what the blobs mean
         (e.g. the matrix table's pre-segmented device-key requests)."""
         msg_id = self._new_request()
+        self._send_request(msg_type, blobs, msg_id)
+        return msg_id
+
+    def _send_request(self, msg_type: MsgType, blobs: Sequence[Blob],
+                      msg_id: int) -> None:
+        """Build and route a request message for an ALREADY-allocated
+        id — the prefetch/dedup machinery allocates first (so reply
+        routing state can be registered before anything is in flight)
+        and sends later (possibly from a completion callback)."""
         msg = Message(src=self._zoo.rank, dst=-1, msg_type=msg_type,
                       table_id=self.table_id, msg_id=msg_id)
         for blob in blobs:
             msg.push(blob)
         self._zoo.send_to(actors.WORKER, msg)
+
+    def _local_done(self) -> int:
+        """A request satisfied locally (cache hit / no-op prefetch):
+        allocate a normal request id and complete it immediately, so
+        async callers get an id whose ``wait`` returns at once."""
+        msg_id = self._new_request()
+        self.notify(msg_id)
         return msg_id
 
     def _new_request(self) -> int:
@@ -150,14 +182,24 @@ class WorkerTable:
         otherwise unblock early, and a late sibling could write into the
         NEXT request's destination (the one-get-in-flight registers are
         shared). Callers whose control flow already notifies (the reply
-        handlers' finally blocks) pass ``count=False``. Entries for
-        requests nobody waits on persist until shutdown — errors are
-        bugs, not steady-state traffic."""
+        handlers' finally blocks) pass ``count=False``. At most
+        ``_MAX_RETAINED_ERRORS`` completed-request entries are retained
+        for late ``wait`` calls; past that the oldest completed ones are
+        reaped so never-waited fire-and-forget failures don't accumulate
+        over a long run."""
         with self._mutex:
             # First error wins: follow-up failures of the same request
             # (e.g. the empty BSP clock-tick shards sent after a
             # partition failure) must not mask the root cause.
             self._errors.setdefault(msg_id, reason)
+            if len(self._errors) > _MAX_RETAINED_ERRORS:
+                # Insertion order = age; entries still in _waitings are
+                # in flight (their requester may yet wait) — keep those.
+                for stale in list(self._errors):
+                    if stale != msg_id and stale not in self._waitings:
+                        del self._errors[stale]
+                        if len(self._errors) <= _MAX_RETAINED_ERRORS:
+                            break
         if count:
             self.notify(msg_id)
 
@@ -166,6 +208,10 @@ class WorkerTable:
             waiter = self._waitings.get(msg_id)
         if waiter is not None:
             waiter.reset(num_wait)
+            if num_wait <= 0:
+                # Re-armed to zero (empty partition): completion
+                # callbacks must still fire or cache blocks strand.
+                self._complete_if_done(msg_id, waiter)
 
     def notify(self, msg_id: int) -> None:
         with self._mutex:
@@ -173,12 +219,59 @@ class WorkerTable:
         if waiter is not None:
             waiter.notify()
             if waiter.done:
-                # Reap completed waiters here, not only in wait():
-                # fire-and-forget async adds (never waited) would otherwise
-                # leak one Waiter per request over a long run.
-                with self._mutex:
-                    if self._waitings.get(msg_id) is waiter and waiter.done:
-                        self._waitings.pop(msg_id, None)
+                self._complete_if_done(msg_id, waiter)
+
+    def _complete_if_done(self, msg_id: int, waiter: Waiter) -> None:
+        """Reap the completed waiter (fire-and-forget async adds would
+        otherwise leak one per request) and run any registered
+        completion callbacks exactly once."""
+        if not waiter.done:
+            return
+        with self._mutex:
+            if self._waitings.get(msg_id) is waiter:
+                self._waitings.pop(msg_id, None)
+            callbacks = self._on_complete.pop(msg_id, None)
+        for fn in callbacks or ():
+            try:
+                fn(msg_id)
+            except Exception:  # noqa: BLE001 - a callback must not
+                # poison the worker actor's reply loop
+                log.error("table %d: completion callback for request "
+                          "%d raised", self.table_id, msg_id)
+                import traceback
+                traceback.print_exc()
+
+    def add_completion(self, msg_id: int,
+                       fn: Callable[[int], None]) -> None:
+        """Run ``fn(msg_id)`` when the request completes (all shard
+        replies in). If it already completed, run immediately — the
+        check and the registration share the mutex with the completion
+        sweep, so a callback can never be orphaned by a racing reply."""
+        run_now = False
+        with self._mutex:
+            if msg_id in self._waitings:
+                self._on_complete.setdefault(msg_id, []).append(fn)
+            else:
+                run_now = True
+        if run_now:
+            fn(msg_id)
+
+    # -- client-cache version plumbing (driven by the worker actor) --
+    def note_version(self, server_id: int, version: int) -> None:
+        """Record a version stamp observed on a reply from a server."""
+        self._version_tracker.note(server_id, version)
+
+    def _begin_reply(self, server_id: int, version: int,
+                     msg_id: int) -> None:
+        """Reply context for ``process_reply_get`` (single worker-actor
+        thread — plain attributes, no lock needed)."""
+        self._reply_server = server_id
+        self._reply_version = version
+        self._reply_msg_id = msg_id
+        self.note_version(server_id, version)
+
+    def _end_reply(self) -> None:
+        self._reply_server = self._reply_version = self._reply_msg_id = -1
 
     # -- virtuals (ref: table_interface.h:44-51) --
     def partition(self, blobs: List[Blob],
@@ -198,9 +291,21 @@ class ServerTable:
     """Storage-side shard; lives on every server rank. Serializable
     (ref: table_interface.h:61-75)."""
 
+    #: Whether this table's process_add/process_get dispatch jitted
+    #: device programs — those must serialize under the server actor's
+    #: process-wide table lock (two in-process server threads
+    #: interleaving multi-device XLA executions deadlock the CPU
+    #: runtime). Host-only tables (KV) opt out so two LocalFabric
+    #: servers doing control-plane work don't serialize on each other.
+    needs_device_lock = True
+
     def __init__(self, zoo=None):
         self._zoo = zoo if zoo is not None else current_zoo()
         self.table_id: int = self._zoo.register_server_table(self)
+        #: Monotonically increasing shard version: bumped by the server
+        #: actor once per successfully applied Add and stamped on every
+        #: reply (client-cache staleness tracking).
+        self.version = 0
 
     def process_add(self, blobs: List[Blob]) -> None:
         raise NotImplementedError

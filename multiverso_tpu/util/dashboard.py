@@ -124,6 +124,14 @@ class monitor:
         return None
 
 
+def count(name: str) -> None:
+    """Bump a named counter — a Monitor used purely for its call count
+    (elapsed stays 0). The client cache's hit/miss/join counters ride
+    the same registry as the timing monitors so ``Dashboard.display()``
+    shows them side by side."""
+    Dashboard.get(name).add(0.0)
+
+
 def trace_to(log_dir: str):
     """Whole-program xprof capture: everything inside the block —
     including ``monitor(..., trace=True)`` annotations — lands in a

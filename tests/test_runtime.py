@@ -159,6 +159,9 @@ class TestAddCoalescing:
         def server_rank(self, server_id):
             return server_id  # server 0 remote (rank 0), server 1 local
 
+        def rank_to_server_id(self, rank):
+            return rank  # dense map, mirroring server_rank above
+
     class _FakeTable:
         def __init__(self):
             self.events = []
@@ -174,6 +177,12 @@ class TestAddCoalescing:
 
         def fail(self, msg_id, reason, count=True):
             self.events.append(("fail", msg_id, reason))
+
+        def note_version(self, server_id, version):
+            self.events.append(("version", server_id, version))
+
+        def abort(self, reason):
+            self.events.append(("abort", reason))
 
     def _worker(self):
         import numpy as np
@@ -279,7 +288,9 @@ class TestAddCoalescing:
         assert len(replies) == 1
         desc = replies[0].data[0].as_array(np.int32)
         assert desc[0] == 2
-        assert list(desc[1:7]) == [0, 50, 1, 0, 51, 1]  # both failed
+        # Stride-4 descriptor: (table_id, msg_id, err, version); the
+        # unpack-failure path cannot resolve versions (-1).
+        assert list(desc[1:9]) == [0, 50, 1, -1, 0, 51, 1, -1]
 
     def test_batched_reply_notifies_and_fails_per_sub(self):
         import numpy as np
@@ -288,12 +299,124 @@ class TestAddCoalescing:
         from multiverso_tpu.core.message import Message, MsgType
         worker, zoo, table, add, _, _ = self._worker()
         reply = Message(src=0, dst=1, msg_type=MsgType.Reply_BatchAdd)
-        reply.push(Blob(np.array([2, 0, 11, 0, 0, 12, 1], np.int32)))
+        reply.push(Blob(np.array([2, 0, 11, 0, 7, 0, 12, 1, 7],
+                                 np.int32)))
         reply.push(Blob(np.frombuffer(b"ValueError: boom", np.uint8)
                         .copy()))
         worker._process_reply_batch_add(reply)
         assert ("notify", 11) in table.events
         assert ("notify", 12) in table.events
+        # The per-sub version stamp reaches the table's tracker (the
+        # client cache's read-your-writes resolution depends on it).
+        assert ("version", 0, 7) in table.events
         fails = [e for e in table.events if e[0] == "fail"]
         assert len(fails) == 1 and fails[0][1] == 12
         assert "boom" in fails[0][2]
+
+    def test_byte_cap_flushes_exactly_at_limit(self):
+        # Staged bytes crossing MAX_BATCH_BYTES must flush mid-burst,
+        # exactly when the cap is reached — not one message later.
+        import numpy as np
+
+        from multiverso_tpu.core.blob import Blob
+        from multiverso_tpu.core.message import Message, MsgType
+        from multiverso_tpu.runtime import worker as worker_mod
+        worker, zoo, table, add, _, _ = self._worker()
+        chunk = worker_mod.MAX_BATCH_BYTES // 4  # 4 shards hit the cap
+        def big_add(msg_id):
+            msg = Message(src=1, dst=-1, msg_type=MsgType.Request_Add,
+                          table_id=0, msg_id=msg_id)
+            msg.push(Blob(np.ones(chunk // 4, np.float32)))
+            return msg
+        for i in range(3):
+            worker._process_add(big_add(i))
+        assert not [m for _, m in zoo.sent
+                    if m.type == MsgType.Request_BatchAdd]
+        assert worker._pending_bytes[0] == 3 * chunk  # under the cap
+        worker._process_add(big_add(3))  # reaches the cap exactly
+        batches = [m for _, m in zoo.sent
+                   if m.type == MsgType.Request_BatchAdd]
+        assert len(batches) == 1
+        assert not worker._pending and not worker._pending_bytes
+        from multiverso_tpu.core.message import unpack_add_batch
+        assert [s.msg_id for s in unpack_add_batch(batches[0])] \
+            == [0, 1, 2, 3]
+
+    def test_count_cap_flushes_exactly_at_limit(self):
+        # The 64th staged shard (not the 65th) must trigger the flush.
+        from multiverso_tpu.core.message import unpack_add_batch
+        from multiverso_tpu.runtime import worker as worker_mod
+        worker, zoo, table, add, Message, MsgType = self._worker()
+        for i in range(worker_mod.MAX_BATCH_MSGS - 1):
+            worker._process_add(add(i))
+        assert not [m for _, m in zoo.sent
+                    if m.type == MsgType.Request_BatchAdd]
+        assert len(worker._pending[0]) == worker_mod.MAX_BATCH_MSGS - 1
+        worker._process_add(add(worker_mod.MAX_BATCH_MSGS - 1))
+        batches = [m for _, m in zoo.sent
+                   if m.type == MsgType.Request_BatchAdd]
+        assert len(batches) == 1
+        assert len(unpack_add_batch(batches[0])) \
+            == worker_mod.MAX_BATCH_MSGS
+        assert not worker._pending
+
+    def test_staged_batch_survives_abort_and_drain_exit(self):
+        # A staged batch interleaved with an abort must still hit the
+        # wire on drain-exit: no stranded waiters (every sub keeps its
+        # reset bookkeeping), no lost adds (the flush happens even
+        # though the tables were just aborted).
+        from multiverso_tpu.core.message import unpack_add_batch
+        worker, zoo, table, add, Message, MsgType = self._worker()
+        worker._process_add(add(1))
+        worker._process_add(add(2))
+        assert len(worker._pending[0]) == 2  # staged, not on the wire
+        worker.abort_tables("peer died mid-burst")
+        assert ("abort", "peer died mid-burst") in table.events
+        # Drain-exit: mailbox closes, _main's exit path must flush.
+        worker.mailbox.exit()
+        worker._main()
+        batches = [m for _, m in zoo.sent
+                   if m.type == MsgType.Request_BatchAdd]
+        assert len(batches) == 1
+        assert [s.msg_id for s in unpack_add_batch(batches[0])] == [1, 2]
+        assert not worker._pending
+
+
+class TestServerLockScoping:
+    def test_two_servers_progress_concurrently_on_host_paths(self,
+                                                             monkeypatch):
+        # Regression (BENCH_r05 ps_two_servers at 0.809x of single):
+        # the process-wide table lock exists for multi-device jitted
+        # dispatch; two LocalFabric servers doing HOST-side control
+        # work (KV tables) must not serialize on it. Each server's
+        # process_get waits for the OTHER server to enter its own
+        # process_get: if the old global lock still covered KV logic,
+        # one server would hold it while waiting and the other could
+        # never enter — the waits time out and the flags read False.
+        import multiverso_tpu as mv
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        from multiverso_tpu.tables.kv_table import KVServer
+
+        entered = [threading.Event(), threading.Event()]
+        overlapped = [False, False]
+        orig = KVServer.process_get
+
+        def coordinated(self, blobs):
+            sid = self._zoo.server_id
+            entered[sid].set()
+            overlapped[sid] = entered[1 - sid].wait(timeout=15)
+            return orig(self, blobs)
+
+        monkeypatch.setattr(KVServer, "process_get", coordinated)
+
+        def body(rank):
+            table = mv.create_kv_table()
+            if rank == 0:
+                # keys 0 and 1 hash to servers 0 and 1: one request,
+                # one concurrently-processed shard per server.
+                table.get([0, 1])
+            mv.current_zoo().barrier()
+            return True
+
+        assert LocalCluster(2).run(body) == [True, True]
+        assert overlapped == [True, True], overlapped
