@@ -17,11 +17,14 @@ python -m pytest tests/ --collect-only -q > /tmp/mv_collect.log 2>&1 \
     || { cat /tmp/mv_collect.log; echo "FATAL: test collection errors"; \
          exit 1; }
 
-echo "== fast wire-codec + client-cache subsets =="
-# The two wire-facing suites run first and explicitly: a regression in
-# the codec frames or the versioned cache must name itself, not hide
-# inside the full run's output.
+echo "== fast wire-codec + client-cache + allreduce subsets =="
+# The wire-facing suites run first and explicitly: a regression in the
+# codec frames, the versioned cache, or the collective engine must name
+# itself, not hide inside the full run's output.
 python -m pytest tests/test_wire_codec.py tests/test_client_cache.py -x -q
+
+echo "== allreduce engine (ring / rhalving / lossy EF / async writer) =="
+python -m pytest tests/test_allreduce.py -x -q
 
 echo "== unit + in-process integration tests =="
 # Virtual 8-device CPU mesh (tests/conftest.py forces the platform).

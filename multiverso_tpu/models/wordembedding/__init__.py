@@ -6,4 +6,5 @@ from .device_train import (DeviceCorpusTrainer,  # noqa: F401
                            PSDeviceCorpusTrainer)
 from .dictionary import Dictionary  # noqa: F401
 from .huffman import HuffmanTree, build_huffman  # noqa: F401
+from .ma_train import MACorpusTrainer  # noqa: F401
 from .model import PSWord2Vec, Word2Vec, Word2VecConfig  # noqa: F401

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...runtime import device_lock
 from .data import TokenizedCorpus
 from .model import _MAX_EXP, _sigmoid_xent
 
@@ -523,7 +524,11 @@ class _CorpusOnDevice:
             model.dictionary.subsample_keep_prob(config.sample))
 
     def prep_epoch(self, key):
-        return _prep(self.flat, self.sent, self.keep, key)
+        # Multi-zoo mode (device_lock.py): the prep program is a
+        # multi-device dispatch like any step — serialize and settle.
+        with device_lock.guard():
+            return device_lock.settle(
+                _prep(self.flat, self.sent, self.keep, key))
 
 
 class DeviceCorpusTrainer:
@@ -604,11 +609,12 @@ class DeviceCorpusTrainer:
             for i in range(real):
                 lrs[i] = model.learning_rate()
                 model.trained_words += raw_per_step
-            (model._emb_in, model._emb_out, loss, pairs,
-             key) = self._group(
-                model._emb_in, model._emb_out, kept, ksent,
-                self._aux[0], self._aux[1], key,
-                jnp.asarray(bases), jnp.asarray(lrs), n_kept_dev)
+            with device_lock.guard():
+                (model._emb_in, model._emb_out, loss, pairs,
+                 key) = device_lock.settle(self._group(
+                    model._emb_in, model._emb_out, kept, ksent,
+                    self._aux[0], self._aux[1], key,
+                    jnp.asarray(bases), jnp.asarray(lrs), n_kept_dev))
             loss_acc = loss if loss_acc is None else loss_acc + loss
             pair_acc = pairs if pair_acc is None else pair_acc + pairs
             if group_hook is not None:
@@ -1036,9 +1042,10 @@ class PSDeviceCorpusTrainer:
         base = np.int32(0) if self._G == 1 else \
             jnp.asarray(np.minimum(np.arange(self._G) * self._C,
                                    max(n_kept, 1)).astype(np.int32))
-        in_ids, out_ids, _aux = self._ids(
-            kept_pad, ksent_pad, self._aux_tables[0],
-            self._aux_tables[1], key, base, n_kept_dev)
+        with device_lock.guard():
+            in_ids, out_ids, _aux = device_lock.settle(self._ids(
+                kept_pad, ksent_pad, self._aux_tables[0],
+                self._aux_tables[1], key, base, n_kept_dev))
 
         def caps(ids_nd):
             flat = np.sort(np.asarray(ids_nd).ravel())
@@ -1067,7 +1074,9 @@ class PSDeviceCorpusTrainer:
         # Pad ONCE per epoch; the per-step ids program then slices the
         # padded stream directly (padding per step would re-copy the
         # whole ~24 MB stream every block).
-        kept_pad, ksent_pad = self._pad(kept, ksent)
+        with device_lock.guard():
+            kept_pad, ksent_pad = device_lock.settle(
+                self._pad(kept, ksent))
         n_kept = int(n_kept_dev)
         steps = max(math.ceil(n_kept / C), 1)
         if max_steps:
@@ -1105,11 +1114,13 @@ class PSDeviceCorpusTrainer:
                 # calibrated slice of the sorted ids; the step wrapper
                 # reassembles replies and re-slices the push deltas in
                 # the same program.
-                segs_i, segs_o, pmask, meta_i, meta_o, ovf = \
-                    self._seg_ids(kept_pad, ksent_pad,
-                                  self._aux_tables[0],
-                                  self._aux_tables[1], step_key, base,
-                                  n_kept_dev)
+                with device_lock.guard():
+                    segs_i, segs_o, pmask, meta_i, meta_o, ovf = \
+                        device_lock.settle(self._seg_ids(
+                            kept_pad, ksent_pad,
+                            self._aux_tables[0],
+                            self._aux_tables[1], step_key, base,
+                            n_kept_dev))
                 mid_in = in_table.get_rows_device_segments_async(segs_i)
                 mid_out = out_table.get_rows_device_segments_async(
                     segs_o)
@@ -1117,8 +1128,10 @@ class PSDeviceCorpusTrainer:
                 out_table.wait(mid_out)
                 v = tuple(in_table.take_device_row_parts())
                 u = tuple(out_table.take_device_row_parts())
-                d_v_segs, d_u_segs, loss, pairs = self._seg_step(
-                    v, u, meta_i, meta_o, pmask, lr, inv_w)
+                with device_lock.guard():
+                    d_v_segs, d_u_segs, loss, pairs = device_lock.settle(
+                        self._seg_step(
+                            v, u, meta_i, meta_o, pmask, lr, inv_w))
                 model._pending_pushes.append(
                     (in_table, in_table.add_rows_device_segments_async(
                         segs_i, d_v_segs)))
@@ -1133,9 +1146,10 @@ class PSDeviceCorpusTrainer:
                 # out_ids: [band | negs] / [centers | negs] / Huffman
                 # path rows — see _block_ids_fn / _block_ids_fn_hs;
                 # leading G axis when grouped.
-                in_ids, out_ids, pmask = self._ids(
-                    kept_pad, ksent_pad, self._aux_tables[0],
-                    self._aux_tables[1], step_key, base, n_kept_dev)
+                with device_lock.guard():
+                    in_ids, out_ids, pmask = device_lock.settle(self._ids(
+                        kept_pad, ksent_pad, self._aux_tables[0],
+                        self._aux_tables[1], step_key, base, n_kept_dev))
                 # Device-key pulls ride the worker->server actor round
                 # trip; the replies are lazy device arrays (no host
                 # sync).
@@ -1148,8 +1162,9 @@ class PSDeviceCorpusTrainer:
                 # multi-server tables).
                 v = tuple(in_table.take_device_row_parts())
                 u = tuple(out_table.take_device_row_parts())
-                d_v, d_u, loss, pairs = self._step(
-                    v, u, pmask, lr, inv_w)
+                with device_lock.guard():
+                    d_v, d_u, loss, pairs = device_lock.settle(
+                        self._step(v, u, pmask, lr, inv_w))
                 # Fire-and-forget pushes: waiters self-reap on ack; the
                 # trailing drain below bounds the epoch.
                 model._pending_pushes.append(

@@ -1,4 +1,5 @@
 """Device-mesh collectives and model-average training (the ICI data plane)."""
 
 from .collective import (allreduce_mesh, pmean_mesh, psum_scalar)  # noqa: F401
-from .ma import MASGDStep, model_average  # noqa: F401
+from .ma import (MAAverager, MAFuture, MASGDStep,  # noqa: F401
+                 model_average, model_average_async)

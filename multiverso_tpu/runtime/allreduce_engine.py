@@ -2,36 +2,98 @@
 
 Functional equivalent of the reference's ``AllreduceEngine``
 (ref: include/multiverso/net/allreduce_engine.h:80-168,
-src/net/allreduce_engine.cpp:31-172): a Bruck-style allgather and a
-recursive-halving reduce-scatter composed into an allreduce, with the same
-size-based algorithm choice (small payloads take the allgather path,
-ref: allreduce_engine.cpp:31-54).
+src/net/allreduce_engine.cpp:31-172), grown into a chunked, pipelined
+collective stack:
+
+- **small path**: Bruck-style doubling allgather + local reduce, same
+  size threshold as the reference (ref: allreduce_engine.cpp:31-54);
+- **recursive halving**: the reference's reduce-scatter + allgather with
+  an initial fold of surplus ranks onto a power-of-two group — the
+  *monolithic* path (one blocking sendrecv per round);
+- **chunked ring** (new): ring reduce-scatter + ring allgather over
+  ``-allreduce_chunk_kb`` chunks with a sliding window of in-flight
+  frames riding the transport's ``send_async`` writer threads, so round
+  k's wire time overlaps round k+1's receive + reduce (SparCML-style
+  chunking). Works for ANY rank count (no surplus fold), which is why
+  non-power-of-two worlds prefer it even at modest sizes.
+
+Per-chunk segments >= 4 KB ride the wire codec; the opt-in
+``-allreduce_lossy`` tier quantizes segment values (int8 / f16 via
+``util/wire_codec``) *inside* the collective with per-destination
+error-feedback residuals carried across calls (EQuARX-style), so
+quantization noise averages out over training steps instead of
+accumulating. In the allgather phase each reduced segment is encoded
+ONCE at its owner and the encoded frame is forwarded verbatim around the
+ring — no re-quantization per hop, and every rank (owner included)
+decodes the same bytes, so lossy results are still bit-identical across
+ranks.
+
+Every message's ``msg_id`` carries a per-call generation in its high
+bits: back-to-back collectives with different round counts (or a future
+concurrent caller) can never cross-match stash entries.
 
 On TPU this engine is the *fallback* path: the data plane rides XLA
 collectives over ICI (``multiverso_tpu.parallel``); this host-side engine
 exists for model-average mode over the control transport where no device
-mesh spans the ranks (the reference's ``-ma`` mode bypasses the PS the same
-way, ref: src/zoo.cpp:49). It drives the raw endpoint directly, so it must
-only run when the PS actors are down (ma mode) — exactly the reference's
-usage pattern.
-
-The algorithms are implemented from their standard formulations (Bruck
-doubling allgather; recursive halving with an initial fold of surplus ranks
-onto a power-of-two group), not transcribed from the reference.
+mesh spans the ranks (the reference's ``-ma`` mode bypasses the PS the
+same way, ref: src/zoo.cpp:49). It drives the raw endpoint directly, so
+it must only run when the PS actors are down (ma mode) — exactly the
+reference's usage pattern. See docs/ALLREDUCE.md for the algorithm
+choice table and flag semantics.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import collections
+import time
+from typing import Callable, Dict, Tuple
 
 import numpy as np
 
 from ..core.blob import Blob
 from ..core.message import Message, MsgType, is_wire_encoded
-from ..util.configure import get_flag
+from ..util.configure import (define_bool, define_double, define_int,
+                              define_string, get_flag)
 from ..util.wire_codec import (CODEC_SLOT, decode_blob, encode_blob,
                                worth_encoding)
 from .net import NetInterface
+
+define_string("allreduce_algo", "auto",
+              "large-payload allreduce algorithm: auto (pick by payload "
+              "size and rank count) | ring (chunked pipelined ring) | "
+              "rhalving (monolithic recursive halving)")
+define_int("allreduce_chunk_kb", 512,
+           "ring path: split the flat buffer into chunks of this many "
+           "KB; each chunk is an independent ring whose frames pipeline "
+           "on the transport writer threads. Smaller chunks overlap "
+           "more but pay more per-frame overhead (~0.3-1.5 ms each on "
+           "a single-core host); 512 is the measured sweet spot for "
+           "4-16 MB buffers on the bench wire")
+define_int("allreduce_window", 4,
+           "ring path: max in-flight (sent but not yet matched by a "
+           "receive) chunks per ring step")
+define_int("allreduce_ring_kb", 256,
+           "auto algorithm choice: payloads at least this many KB take "
+           "the chunked ring path (non-power-of-two worlds switch "
+           "earlier — the recursive-halving surplus fold costs two "
+           "extra full-buffer serial hops)")
+define_double("allreduce_timeout_s", 120.0,
+              "seconds a collective waits for one peer frame before "
+              "failing loudly (tests lower this to fail fast)")
+define_int("allreduce_stash_cap", 4096,
+           "max early-arriving frames stashed while waiting for a "
+           "specific (src, tag); exceeding it means a crashed peer or a "
+           "tag-protocol bug and fails loudly instead of growing "
+           "unboundedly")
+
+# Lossy tier flag lives here (the codec's -wire_codec_lossy governs the
+# PS matrix-Add filter stage; the collective gets its own opt-in).
+define_bool("allreduce_lossy", False,
+            "quantize allreduce segment values (int8/f16 wire-codec "
+            "tiers) inside the collective, with per-destination "
+            "error-feedback residuals carried across calls "
+            "(EQuARX-style). Lossless when off — bit-identical to the "
+            "unquantized path")
 
 _SMALL_BYTES = 4096  # allgather-based path threshold (ref: engine.cpp:33)
 
@@ -40,50 +102,150 @@ _SMALL_BYTES = 4096  # allgather-based path threshold (ref: engine.cpp:33)
 #: deltas shrink, dense ones ride RAW with only the header overhead).
 _CODEC_MIN_BYTES = 4096
 
+# -- msg_id layout: [ 11-bit generation | 20-bit tag ] ----------------
+# The generation increments once per public collective call (all ranks
+# call collectives in the same order, so engine counters stay in sync);
+# a stale frame from call g can never match a key from call g+1 even
+# when the low tag bits collide. Tag bases partition the 20-bit space:
+_TAG_BITS = 20
+_GEN_MOD = 2047  # 11 bits, cycling 1..2047 (msg_id stays positive i32)
+_BRUCK_BASE = 1000       # doubling allgather rounds
+_RH_BASE = 2000          # recursive-halving rounds
+_RH_RESULT = 2900        # surplus-rank final result
+_RING_RS_BASE = 100000   # ring reduce-scatter: base + step*nchunks + chunk
+_RING_AG_BASE = 550000   # ring allgather:     base + step*nchunks + chunk
+_RING_TAG_SPAN = 400000  # per-phase room; bounds (size-1)*nchunks
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
 
 class AllreduceEngine:
     def __init__(self, net: NetInterface):
         self._net = net
         self.rank = net.rank
         self.size = net.size
-        self._stash = {}  # (src, tag) -> blob, for early-arriving rounds
+        # (src, msg_id) -> (blob, wire_encoded): early-arriving frames.
+        # Decoding is lazy so allgather forwarding can relay the exact
+        # received frame bytes.
+        self._stash: Dict[Tuple[int, int], Tuple[Blob, bool]] = {}
+        self._gen = 0
+        # Error-feedback residuals, keyed by (phase, element count):
+        # carried across calls so quantization noise from step t is
+        # folded into step t+1's payload (OneBitFilter convention).
+        self._ef: Dict[Tuple[str, int], np.ndarray] = {}
         # Frames are self-describing (CODEC_SLOT marks an encoded
         # payload), so decode needs no negotiation; in ma mode every
         # rank runs this same engine. In-process transports move object
-        # references — encoding there only burns CPU.
+        # references — lossless encoding there only burns CPU (the
+        # lossy tier still engages: its point is the quantization
+        # semantics, not the bytes).
         self._codec = (not net.in_process
                        and bool(get_flag("wire_codec")))
 
+    # -- msg_id construction --
+    def _mid(self, tag: int) -> int:
+        return (self._gen << _TAG_BITS) | tag
+
+    def _next_gen(self) -> None:
+        self._gen = (self._gen % _GEN_MOD) + 1
+
     # -- raw paired exchange over the message transport --
-    def _send(self, dst: int, payload: np.ndarray, tag: int) -> None:
+    def _post(self, dst: int, blob: Blob, tag: int, encoded: bool) -> None:
         msg = Message(src=self.rank, dst=dst, msg_type=MsgType.Default,
-                      msg_id=tag)
+                      msg_id=self._mid(tag))
+        msg.push(blob)
+        if encoded:
+            msg.header[CODEC_SLOT] = 1
+        self._net.send_async(msg)
+
+    def _send(self, dst: int, payload: np.ndarray, tag: int) -> None:
+        """Lossless send: codec-framed when the wire would benefit."""
         payload = np.ascontiguousarray(payload)
+        if self._net.in_process and payload.base is not None:
+            # In-process transports deliver references; a view of this
+            # rank's working buffer must be snapshotted, or a receiver
+            # still holding it (e.g. an allgather forward) would observe
+            # later in-place mutations.
+            payload = payload.copy()
         # worth_encoding gates on density too: dense model-average
         # segments (the common ma workload) skip the frame-copy round
         # trip a RAW frame would cost.
         if self._codec and payload.nbytes >= _CODEC_MIN_BYTES \
                 and worth_encoding(payload):
             frame, _ = encode_blob(payload)  # lossless tiers only
-            msg.push(Blob(np.frombuffer(frame, np.uint8)))
-            msg.header[CODEC_SLOT] = 1
+            self._post(dst, Blob(np.frombuffer(frame, np.uint8)), tag, True)
         else:
-            msg.push(Blob(payload))
-        self._net.send(msg)
+            self._post(dst, Blob(payload), tag, False)
+
+    def _send_lossy(self, dst: int, flat: np.ndarray, lo: int, hi: int,
+                    tag: int, ef: np.ndarray) -> np.ndarray:
+        """Quantized send of ``flat[lo:hi]`` with error feedback: the
+        residual from this range's previous quantization is folded into
+        the values before encoding and the fresh residual stored back.
+        Segments below the codec threshold fall back to the lossless
+        path (the folded correction goes out exactly, so the residual
+        zeroes). Returns the values AS THE RECEIVER WILL DECODE THEM —
+        allgather origins adopt these so every rank lands on identical
+        bytes."""
+        vals = flat[lo:hi] + ef[lo:hi]
+        if vals.nbytes < _CODEC_MIN_BYTES:
+            ef[lo:hi] = 0.0
+            self._send(dst, vals, tag)
+            return vals
+        frame, residual = encode_blob(vals, lossy=True)
+        ef[lo:hi] = residual if residual is not None else 0.0
+        self._post(dst, Blob(np.frombuffer(frame, np.uint8)), tag, True)
+        # decoded == vals - residual; reconstruct instead of re-decoding.
+        return vals - ef[lo:hi]
+
+    def _drain_until(self, src: int, tag: int) -> Tuple[Blob, bool]:
+        """Tag-matched receive: a fast peer's next-round message may
+        arrive before the one this round is waiting on; stash and keep
+        draining. Fails loudly (with full context) on timeout, closed
+        transport, or unbounded stash growth."""
+        key = (src, self._mid(tag))
+        timeout = float(get_flag("allreduce_timeout_s"))
+        cap = int(get_flag("allreduce_stash_cap"))
+        start = time.monotonic()
+        while key not in self._stash:
+            remaining = timeout - (time.monotonic() - start)
+            msg = self._net.recv(timeout=max(remaining, 0.001)) \
+                if remaining > 0 else None
+            if msg is None:
+                raise RuntimeError(
+                    f"allreduce engine rank {self.rank}: transport closed "
+                    f"or timed out after {time.monotonic() - start:.1f}s "
+                    f"(timeout {timeout:.1f}s, -allreduce_timeout_s) "
+                    f"waiting for peer {src} msg_id 0x{self._mid(tag):x} "
+                    f"(gen {self._gen}, tag {tag}); stash holds "
+                    f"{len(self._stash)} early frames "
+                    f"{sorted(self._stash)[:8]}")
+            self._stash[(msg.src, msg.msg_id)] = \
+                (msg.data[0], is_wire_encoded(msg))
+            if key in self._stash:
+                # The awaited frame landed: popping it below shrinks
+                # the stash again, so don't let a boundary-sitting cap
+                # fail a collective at the moment it makes progress.
+                break
+            if len(self._stash) > cap:
+                sample = sorted(self._stash)[:8]
+                raise RuntimeError(
+                    f"allreduce engine rank {self.rank}: stash exceeded "
+                    f"{cap} unmatched frames (-allreduce_stash_cap) while "
+                    f"waiting for peer {src} msg_id 0x{self._mid(tag):x} "
+                    f"— a crashed peer or tag-protocol bug is flooding "
+                    f"the endpoint; sample keys {sample}")
+        return self._stash.pop(key)
 
     def _recv(self, src: int, tag: int, dtype) -> np.ndarray:
-        """Tag-matched receive: a fast peer's next-round message may arrive
-        before the one this round is waiting on; stash and keep draining."""
-        key = (src, tag)
-        while key not in self._stash:
-            msg = self._net.recv(timeout=120)
-            if msg is None:
-                raise RuntimeError("allreduce engine: transport closed")
-            blob = msg.data[0]
-            if is_wire_encoded(msg):
-                blob = Blob(decode_blob(np.asarray(blob.data)))
-            self._stash[(msg.src, msg.msg_id)] = blob
-        return self._stash.pop(key).as_array(dtype)
+        blob, encoded = self._drain_until(src, tag)
+        if encoded:
+            decoded = decode_blob(np.asarray(blob.data))
+            return decoded if decoded.dtype == np.dtype(dtype) \
+                else np.asarray(decoded, dtype=dtype)
+        return blob.as_array(dtype)
 
     def _exchange(self, peer: int, payload: np.ndarray,
                   tag: int) -> np.ndarray:
@@ -91,29 +253,49 @@ class AllreduceEngine:
         self._send(peer, payload, tag)
         return self._recv(peer, tag, payload.dtype)
 
+    # -- algorithm choice --
+    def _pick_algo(self, nbytes: int) -> str:
+        algo = str(get_flag("allreduce_algo"))
+        if algo in ("ring", "rhalving"):
+            return algo
+        if nbytes >= int(get_flag("allreduce_ring_kb")) * 1024:
+            return "ring"
+        if not _is_pow2(self.size) and nbytes >= 4 * _SMALL_BYTES:
+            # Surplus fold pays 2 extra full-buffer serial hops; the
+            # ring needs no fold, so non-pow2 worlds switch early.
+            return "ring"
+        return "rhalving"
+
     # -- public API (ref: allreduce_engine.h:96-118) --
     def allreduce(self, data: np.ndarray,
                   reducer: Callable = np.add) -> np.ndarray:
         data = np.asarray(data)
         if self.size == 1:
             return data.copy()
+        self._next_gen()
         if data.nbytes < _SMALL_BYTES or data.size < self.size:
             # Small path: allgather everyone's buffer, reduce locally
             # (ref: allreduce_engine.cpp:34-43).
-            stacked = self.allgather(data)
+            stacked = self._bruck_allgather(data)
             out = stacked[0]
             for part in stacked[1:]:
                 out = reducer(out, part)
             return out
+        if self._pick_algo(data.nbytes) == "ring":
+            return self._ring_allreduce(data, reducer)
         return self._reduce_scatter_allgather(data, reducer)
 
     def allgather(self, data: np.ndarray) -> list:
+        self._next_gen()
+        return self._bruck_allgather(data)
+
+    def _bruck_allgather(self, data: np.ndarray) -> list:
         """Bruck doubling allgather: after round k every rank holds 2^(k+1)
         blocks; blocks are sent to rank-2^k and received from rank+2^k
         (ref: allreduce_engine.cpp:90-117, allreduce_topo.cpp:20-37)."""
         n = self.size
         blocks = [np.asarray(data)]
-        tag = 1000
+        tag = _BRUCK_BASE
         distance = 1
         while distance < n:
             dst = (self.rank - distance) % n
@@ -135,6 +317,129 @@ class AllreduceEngine:
             ordered[(self.rank + j) % n] = block
         return ordered
 
+    # -- chunked pipelined ring --------------------------------------
+    def _ring_allreduce(self, data: np.ndarray,
+                        reducer: Callable) -> np.ndarray:
+        """Ring reduce-scatter + ring allgather over chunks, with a
+        sliding window of in-flight chunks per step. Any rank count.
+
+        Reduce-scatter step s: send segment (rank-s) of every chunk to
+        the right neighbor, receive segment (rank-s-1) from the left and
+        fold it in; after n-1 steps this rank owns the fully reduced
+        segment (rank+1). Allgather step s: forward segment (rank+1-s)
+        right, receive (rank-s) from the left. Sends ride
+        ``send_async`` writer threads, so while this rank blocks on
+        chunk c's inbound frame, chunks c+1..c+window are already on
+        the wire and the previous chunk's reduce ran during their
+        transfer — wire time and reduce time overlap instead of
+        alternating."""
+        n, r = self.size, self.rank
+        right, left = (r + 1) % n, (r - 1) % n
+        shape = np.asarray(data).shape
+        flat = np.asarray(data).reshape(-1).copy()
+        N = flat.size
+        chunk_elems = max(1, (int(get_flag("allreduce_chunk_kb")) * 1024)
+                          // max(flat.itemsize, 1))
+        nchunks = max(1, -(-N // chunk_elems))
+        # Tag-space guard: (n-1)*nchunks must fit each phase's band.
+        nchunks = min(nchunks, max(1, _RING_TAG_SPAN // max(n - 1, 1)))
+        cb = np.linspace(0, N, nchunks + 1).astype(np.int64)
+        segs = [np.linspace(cb[c], cb[c + 1], n + 1).astype(np.int64)
+                for c in range(nchunks)]
+        window = max(1, int(get_flag("allreduce_window")))
+        # Lossy only for float32 SUMS: the error-feedback identity
+        # (residual folded into the next payload cancels over
+        # accumulation) only holds for additive reduction — adding a
+        # carried residual before a max/min would corrupt the result.
+        lossy = bool(get_flag("allreduce_lossy")) \
+            and flat.dtype == np.float32 and reducer is np.add
+        ef_rs = self._ef_buffer("rs", N) if lossy else None
+        ef_ag = self._ef_buffer("ag", N) if lossy else None
+
+        def bounds(c: int, seg: int) -> Tuple[int, int]:
+            return int(segs[c][seg]), int(segs[c][seg + 1])
+
+        # Phase 1: reduce-scatter.
+        for step in range(n - 1):
+            send_id = (r - step) % n
+            recv_id = (r - step - 1) % n
+
+            def rs_recv(c: int, step: int = step,
+                        recv_id: int = recv_id) -> None:
+                tag = _RING_RS_BASE + step * nchunks + c
+                lo, hi = bounds(c, recv_id)
+                incoming = self._recv(left, tag, flat.dtype)
+                flat[lo:hi] = reducer(flat[lo:hi], incoming)
+
+            pending = collections.deque()
+            for c in range(nchunks):
+                tag = _RING_RS_BASE + step * nchunks + c
+                lo, hi = bounds(c, send_id)
+                if lossy:
+                    self._send_lossy(right, flat, lo, hi, tag, ef_rs)
+                else:
+                    self._send(right, flat[lo:hi], tag)
+                pending.append(c)
+                if len(pending) >= window:
+                    rs_recv(pending.popleft())
+            while pending:
+                rs_recv(pending.popleft())
+
+        # Phase 2: allgather with verbatim frame forwarding — each
+        # reduced segment is encoded once at its owner; hops relay the
+        # received blob untouched (no per-hop re-quantization), and the
+        # owner adopts its own decoded frame, so every rank lands on
+        # the same bytes even in lossy mode.
+        carry: list = [None] * nchunks
+        for step in range(n - 1):
+            send_id = (r + 1 - step) % n
+            recv_id = (r - step) % n
+
+            def ag_recv(c: int, step: int = step,
+                        recv_id: int = recv_id) -> None:
+                tag = _RING_AG_BASE + step * nchunks + c
+                blob, encoded = self._drain_until(left, tag)
+                lo, hi = bounds(c, recv_id)
+                if encoded:
+                    flat[lo:hi] = decode_blob(np.asarray(blob.data))
+                else:
+                    flat[lo:hi] = blob.as_array(flat.dtype)
+                carry[c] = (blob, encoded)
+
+            pending = collections.deque()
+            for c in range(nchunks):
+                tag = _RING_AG_BASE + step * nchunks + c
+                if step == 0:
+                    lo, hi = bounds(c, send_id)
+                    if lossy:
+                        flat[lo:hi] = self._send_lossy(
+                            right, flat, lo, hi, tag, ef_ag)
+                    else:
+                        self._send(right, flat[lo:hi], tag)
+                else:
+                    blob, encoded = carry[c]
+                    self._post(right, blob, tag, encoded)
+                pending.append(c)
+                if len(pending) >= window:
+                    ag_recv(pending.popleft())
+            while pending:
+                ag_recv(pending.popleft())
+        return flat.reshape(shape)
+
+    def _ef_buffer(self, phase: str, n: int) -> np.ndarray:
+        buf = self._ef.get((phase, n))
+        if buf is None:
+            # One buffer per phase: a residual only means something for
+            # the SAME flat layout, so a size change (new model shape)
+            # both invalidates and evicts the old one — the engine is
+            # cached for the process lifetime and must not pin two
+            # float32 buffers per distinct size ever seen.
+            for key in [k for k in self._ef if k[0] == phase]:
+                del self._ef[key]
+            buf = self._ef[(phase, n)] = np.zeros(n, np.float32)
+        return buf
+
+    # -- monolithic recursive halving ---------------------------------
     def _reduce_scatter_allgather(self, data: np.ndarray,
                                   reducer: Callable) -> np.ndarray:
         """Large path: recursive-halving reduce-scatter then allgather of
@@ -148,14 +453,16 @@ class AllreduceEngine:
         while pow2 * 2 <= n:
             pow2 *= 2
         surplus = n - pow2
-        tag = 2000
+        tag = _RH_BASE
         if self.rank >= pow2:
             # Surplus rank: hand the whole buffer to its leader, then wait
             # for the final result.
             leader = self.rank - pow2
             self._send(leader, flat, tag)
-            result = self._recv(leader, tag + 900, flat.dtype)
-            return result.reshape(np.asarray(data).shape)
+            result = self._recv(leader, _RH_RESULT, flat.dtype)
+            # Copy: in-process the received blob is (a view of) the
+            # leader's result buffer — the caller owns its return value.
+            return result.reshape(np.asarray(data).shape).copy()
         if self.rank < surplus:
             incoming = self._recv(self.rank + pow2, tag, flat.dtype)
             flat = reducer(flat, incoming)
@@ -186,7 +493,7 @@ class AllreduceEngine:
                                          step_tag)
         flat = np.concatenate(gathered)
         if self.rank < surplus:
-            self._send(self.rank + pow2, flat, tag + 900)
+            self._send(self.rank + pow2, flat, _RH_RESULT)
         return flat.reshape(np.asarray(data).shape)
 
     def _gather_segments(self, my_seg, bounds, dtype, tag) -> list:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Optional
 
+from . import device_lock
 from .net import LocalFabric
 from .zoo import ClusterAborted, Zoo, set_thread_zoo
 
@@ -35,6 +36,20 @@ class LocalCluster:
         self.timeout = 120.0
 
     def run(self, fn: Callable[[int], Any]) -> List[Any]:
+        if self.n > 1:
+            # Several virtual ranks share this process's XLA CPU
+            # runtime: serialize + settle every multi-device dispatch
+            # for the duration (runtime/device_lock.py) — concurrent
+            # sharded programs from sibling ranks can wedge the
+            # execution pool on small hosts.
+            device_lock.enable()
+        try:
+            return self._run(fn)
+        finally:
+            if self.n > 1:
+                device_lock.disable()
+
+    def _run(self, fn: Callable[[int], Any]) -> List[Any]:
         fabric = LocalFabric(self.n)
         results: List[Any] = [None] * self.n
         errors: List[Optional[BaseException]] = [None] * self.n

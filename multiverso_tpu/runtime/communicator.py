@@ -69,6 +69,23 @@ class Communicator(Actor):
     # frames (mixed-version clusters stay correct, merely uncompressed).
     def _dispatch(self, msg: Message) -> None:
         if msg.dst != self._zoo.rank:
+            if self._net.in_process and self._net.size > 1 \
+                    and any(b.on_device for b in msg.data):
+                # Materialize device payloads BEFORE they cross into a
+                # sibling virtual rank (LocalFabric multi-rank = tests
+                # and single-host multi-rank runs only; real one-zoo-
+                # per-process deployments never take this branch). A
+                # sibling's jit consuming a still-in-flight foreign
+                # array can wedge XLA's CPU runtime on a small host:
+                # the consumer occupies the execution pool waiting for
+                # a producer that needs the pool to run (the cross-rank
+                # twin of the Server._table_lock deadlock, observed as
+                # a server gather parked forever on a worker-produced
+                # id array in test_ps_device_pipeline_two_workers).
+                import jax
+                for blob in msg.data:
+                    if blob.on_device:
+                        jax.block_until_ready(blob.data)
             if self._codec and \
                     self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
                 encode_message(msg)

@@ -1,0 +1,72 @@
+"""Process-wide serialization of multi-device dispatch (multi-zoo mode).
+
+XLA's CPU runtime executes dispatched computations on a small shared
+thread pool (sized to the host's cores — ONE on the bench container).
+A multi-device program (8 virtual CPU shards) can partially occupy the
+pool; two such programs in flight from different threads can each hold
+resources the other needs and wedge forever. One zoo per process (the
+real deployment) serializes naturally through the actor mailboxes and
+never hits this; a LocalFabric process hosting SEVERAL virtual ranks
+(tests, single-host multi-rank runs) does — observed as a server-side
+jitted gather parked forever while a sibling rank's trainer program was
+still in flight (test_ps_device_pipeline_two_workers, and the
+server-vs-server variant PR 1 fixed with ``Server._table_lock``).
+
+The fix generalizes PR 1's lock: while ``enable()`` is active (entered
+by ``LocalCluster.run`` for n > 1), EVERY multi-device dispatch site —
+server table jits, worker partition slicing, trainer step programs —
+takes the ONE process lock and ``settle``s its outputs before releasing
+it, so at most one device program is in flight at any moment and none
+escapes its critical section still executing. With no multi-zoo process
+active, ``guard()`` is a no-op context and ``settle`` returns its
+argument untouched — the real deployment keeps full async pipelining.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+#: The one process-wide device-dispatch lock. ``Server._table_lock`` is
+#: this object (kept as a class attribute for its existing callers).
+#: RLock: the sync server's drain paths re-enter through Server._process_*.
+TABLE_LOCK = threading.RLock()
+
+_NULL = contextlib.nullcontext()
+_serialized = 0  # nesting count of active multi-zoo contexts
+_state_lock = threading.Lock()
+
+
+def enable() -> None:
+    """Enter multi-zoo mode: serialize + settle all device dispatch."""
+    global _serialized
+    with _state_lock:
+        _serialized += 1
+
+
+def disable() -> None:
+    global _serialized
+    with _state_lock:
+        _serialized -= 1
+
+
+def active() -> bool:
+    return _serialized > 0
+
+
+def guard():
+    """Context manager for a device-dispatch site: the process lock in
+    multi-zoo mode, a no-op otherwise."""
+    return TABLE_LOCK if _serialized else _NULL
+
+
+def settle(tree):
+    """Block until every device array in ``tree`` has materialized
+    (multi-zoo mode only; identity otherwise). Call INSIDE the guarded
+    region, on its outputs, so no execution escapes the lock."""
+    if _serialized:
+        import jax
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+    return tree

@@ -52,6 +52,32 @@ class NetInterface:
         """Dispatch a message toward ``msg.dst``; returns bytes queued."""
         raise NotImplementedError
 
+    def send_async(self, msg: Message) -> int:
+        """Queue a message for delivery and return immediately; returns
+        bytes queued. Per-destination FIFO order is preserved, both among
+        async sends and relative to later blocking ``send`` calls to the
+        same peer. The caller must not mutate the message's payload until
+        the frame is on the wire (``flush_sends``) — the allreduce engine
+        satisfies this by never rewriting a segment it has queued.
+
+        Default: alias of the blocking ``send`` (correct on any
+        transport; in-process delivery is already instantaneous).
+        Transports with real wire time override this with a writer
+        thread so multiple frames can be in flight (tcp.py)."""
+        return self.send(msg)
+
+    def flush_sends(self, dst: Optional[int] = None,
+                    timeout: Optional[float] = None) -> None:
+        """Block until queued async sends (to ``dst``, or all peers) are
+        on the wire. No-op on transports whose send is synchronous."""
+
+    #: Total payload bytes this endpoint has pushed toward peers
+    #: (wire-framing included where the transport serializes). Bench
+    #: instrumentation; transports that care override/maintain it.
+    @property
+    def bytes_sent(self) -> int:
+        return 0
+
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         """Block for the next inbound message; None once finalized."""
         raise NotImplementedError
@@ -75,7 +101,8 @@ class NetInterface:
     def release_recv_owner(self) -> None:
         self._recv_owned = False
 
-    def allreduce(self, array: "np.ndarray") -> "np.ndarray":
+    def allreduce(self, array: "np.ndarray",
+                  slot: Optional[int] = None) -> "np.ndarray":
         """Sum-allreduce a host array across ranks (the transport-level
         collective behind MV_Aggregate, ref: mpi_net.h:147-151). The
         default drives the AllreduceEngine over this endpoint's raw
@@ -85,19 +112,64 @@ class NetInterface:
 
         One engine is cached per endpoint: its stash of early-arriving
         messages must survive across calls, since in back-to-back
-        allreduces a fast peer's next-call message (tags restart at fixed
-        bases) can be drained during the previous call and would otherwise
-        be lost, deadlocking the next collective."""
+        allreduces a fast peer's next-call message can be drained during
+        the previous call and would otherwise be lost, deadlocking the
+        next collective (per-call generation stamps in the msg_id keep
+        such early frames from ever cross-matching).
+
+        FIFO-serialized per endpoint: collectives are matched
+        POSITIONALLY across ranks, so execution order must equal
+        application call order on every rank. Each call runs in turn
+        behind a ticket — taken here on the calling thread, or
+        reserved earlier via ``reserve_collective_slot`` and passed as
+        ``slot`` (how model_average_async pins its place in line from
+        the submitting thread while the work happens on a worker)."""
         if getattr(self, "_recv_owned", False):
             raise RuntimeError(
                 "transport-level allreduce (mv.aggregate) requires ma mode "
                 "on this transport: the PS actors own the endpoint's recv "
                 "stream (start with -ma=true, ref: src/net.cpp:27-35)")
         from .allreduce_engine import AllreduceEngine
-        engine = getattr(self, "_allreduce_engine", None)
-        if engine is None:
-            engine = self._allreduce_engine = AllreduceEngine(self)
-        return engine.allreduce(array)
+
+        def run():
+            engine = getattr(self, "_allreduce_engine", None)
+            if engine is None:
+                engine = self._allreduce_engine = AllreduceEngine(self)
+            return engine.allreduce(array)
+
+        return self._run_collective(run, slot)
+
+    # -- per-endpoint collective FIFO --
+    def _collective_fifo(self) -> dict:
+        # Lazily created; the instance-dict setdefault is atomic under
+        # the GIL.
+        return self.__dict__.setdefault(
+            "_coll_fifo", {"next": 0, "serving": 0,
+                           "cond": threading.Condition()})
+
+    def reserve_collective_slot(self) -> int:
+        """Take the next FIFO ticket on THIS thread. Pass it to a later
+        ``allreduce(..., slot=...)`` call (possibly from another
+        thread) to run that collective in the order the slot was
+        reserved rather than the order workers get scheduled."""
+        state = self._collective_fifo()
+        with state["cond"]:
+            slot = state["next"]
+            state["next"] += 1
+        return slot
+
+    def _run_collective(self, fn, slot: Optional[int] = None):
+        state = self._collective_fifo()
+        if slot is None:
+            slot = self.reserve_collective_slot()
+        with state["cond"]:
+            state["cond"].wait_for(lambda: state["serving"] == slot)
+        try:
+            return fn()
+        finally:
+            with state["cond"]:
+                state["serving"] += 1
+                state["cond"].notify_all()
 
     @property
     def name(self) -> str:
@@ -119,9 +191,8 @@ class LocalFabric:
         # Shared-memory allreduce state (one in-flight collective at a time,
         # like the reference's serialized MPI_Allreduce).
         self._ar_cond = threading.Condition()
-        self._ar_acc = None
+        self._ar_parts = {}  # rank -> contribution for the open collective
         self._ar_result = None
-        self._ar_joined = 0
         self._ar_generation = 0
 
     @property
@@ -139,18 +210,24 @@ class LocalFabric:
     def inbox(self, rank: int) -> MtQueue:
         return self._inboxes[rank]
 
-    def allreduce(self, array) -> "np.ndarray":
+    def allreduce(self, array, rank: int = -1) -> "np.ndarray":
         import numpy as np
         contribution = np.asarray(array)
         with self._ar_cond:
             generation = self._ar_generation
-            self._ar_acc = contribution.copy() if self._ar_acc is None \
-                else self._ar_acc + contribution
-            self._ar_joined += 1
-            if self._ar_joined == self._size:
-                self._ar_result = self._ar_acc
-                self._ar_acc = None
-                self._ar_joined = 0
+            # Contributions are kept per rank and summed in RANK order at
+            # completion: summing in thread-arrival order would make the
+            # float result depend on scheduling, and the MA overlap tests
+            # assert sync-vs-async trainer runs are bit-identical.
+            self._ar_parts[len(self._ar_parts) if rank < 0 else rank] = \
+                contribution
+            if len(self._ar_parts) == self._size:
+                acc = None
+                for r in sorted(self._ar_parts):
+                    part = self._ar_parts[r]
+                    acc = part.copy() if acc is None else acc + part
+                self._ar_result = acc
+                self._ar_parts = {}
                 self._ar_generation += 1
                 self._ar_cond.notify_all()
             else:
@@ -197,5 +274,6 @@ class LocalNet(NetInterface):
     def interrupt_recv(self) -> None:
         self._fabric.inbox(self._rank).push(_RECV_INTERRUPT)
 
-    def allreduce(self, array):
-        return self._fabric.allreduce(array)
+    def allreduce(self, array, slot=None):
+        return self._run_collective(
+            lambda: self._fabric.allreduce(array, self._rank), slot)

@@ -26,6 +26,7 @@ from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
+from . import device_lock
 from .actor import Actor
 
 define_double("backup_worker_ratio", 0,
@@ -51,7 +52,10 @@ class Server(Actor):
     #: host-only table logic (KV control plane) must not serialize two
     #: in-process server shards against each other — that regression
     #: put ps_two_servers at 0.809x of single-server in BENCH_r05.
-    _table_lock = threading.RLock()
+    #: The lock object itself is the process-wide device-dispatch lock
+    #: (runtime/device_lock.py): in multi-zoo mode trainer and worker
+    #: dispatch sites serialize on the SAME lock.
+    _table_lock = device_lock.TABLE_LOCK
     _no_lock = contextlib.nullcontext()
 
     def _lock_for(self, table):
@@ -93,6 +97,14 @@ class Server(Actor):
                 table = self._store[msg.table_id]
                 with self._lock_for(table):
                     reply.data = table.process_get(msg.data)
+                    # Multi-zoo mode: the gather must finish before the
+                    # lock releases, or its execution overlaps a sibling
+                    # rank's next program (device_lock.py). active()
+                    # gate keeps the list build off the production hot
+                    # path.
+                    if device_lock.active():
+                        device_lock.settle([b.data for b in reply.data
+                                            if b.on_device])
                 # Version stamp: the shard state this Get observed
                 # (client-cache freshness anchor). Error replies stay
                 # unstamped — the worker checks the error flag first.
@@ -111,6 +123,9 @@ class Server(Actor):
                 table = self._store[msg.table_id]
                 with self._lock_for(table):
                     table.process_add(msg.data)
+                    # Multi-zoo mode: the update program (new table
+                    # state) must land before the lock releases.
+                    device_lock.settle(getattr(table, "_data", None))
                 # One bump per APPLIED Add; the ack carries the post-add
                 # version so the adder can resolve its self-invalidated
                 # cache slots (read-your-writes).
@@ -178,6 +193,8 @@ class Server(Actor):
                         table = self._store[sub.table_id]
                         with self._lock_for(table):
                             table.process_add(sub.data)
+                            device_lock.settle(
+                                getattr(table, "_data", None))
                         table.version += 1
                         record(sub.table_id, sub.msg_id, None,
                                table.version)

@@ -27,6 +27,7 @@ inside a jitted step rides XLA collectives and never sees this layer.
 
 from __future__ import annotations
 
+import collections
 import socket
 import struct
 import threading
@@ -38,7 +39,8 @@ import numpy as np
 from ..core.blob import Blob
 from ..core.message import HEADER_SIZE, Message
 from ..util import log
-from ..util.configure import define_int, define_string, get_flag
+from ..util.configure import (define_double, define_int, define_string,
+                              get_flag)
 from ..util.dashboard import monitor
 from ..util.mt_queue import MtQueue
 from ..util.net_util import local_addresses
@@ -47,6 +49,20 @@ from .net import NetInterface
 define_string("machine_file", "", "path: one host[:port] per rank line")
 define_int("port", 55555, "default TCP port when a machine-file line has none")
 define_int("rank", -1, "explicit rank override for machine-file bootstrap")
+define_int("send_queue_mb", 32,
+           "per-peer async send queue cap (MB): send_async blocks "
+           "(backpressure) once this many serialized bytes are in flight "
+           "to one destination — the transport twin of the worker "
+           "coalescer's 4MB flush cap")
+define_double("net_pace_mbps", 0.0,
+              "emulate a constrained wire: pace outbound frames to this "
+              "many megabits/s. The sleep happens BEFORE each write "
+              "while holding the destination's send lock, so a frame "
+              "occupies the emulated wire for its transmission time and "
+              "its ARRIVAL is delayed accordingly — on the writer "
+              "thread for async sends (the caller keeps computing), on "
+              "the caller for blocking sends. Bench/test knob for "
+              "reproducing DCN-speed behavior on localhost; 0 = off")
 
 _HDR = struct.Struct("<8i")
 _LEN = struct.Struct("<Q")
@@ -114,6 +130,114 @@ def _deserialize(body: bytes) -> Message:
     return msg
 
 
+class _PeerWriter:
+    """Per-destination writer thread + bounded frame queue.
+
+    ``send_async`` enqueues serialized frames here; the thread drains
+    them through the shared per-destination socket (under the same
+    ``_out_locks[dst]`` the blocking path takes, so async and sync
+    frames never interleave mid-write). Backpressure: ``submit`` blocks
+    once ``-send_queue_mb`` of serialized bytes are queued — a runaway
+    producer degrades to the blocking-send behavior instead of buffering
+    without bound. A wire error parks in ``error`` and is re-raised to
+    the next submit/flush (the writer thread has no caller to raise
+    into)."""
+
+    def __init__(self, net: "TcpNet", dst: int):
+        self._net = net
+        self._dst = dst
+        self._frames: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._queued_bytes = 0
+        self._writing = False
+        self._closed = False
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._main, daemon=True,
+            name=f"mv-tcp-write-r{net.rank}-d{dst}")
+        self._thread.start()
+
+    def submit(self, frame: bytes) -> None:
+        cap = max(1, int(get_flag("send_queue_mb"))) << 20
+        with self._cond:
+            while (self._queued_bytes >= cap and self.error is None
+                   and not self._closed):
+                self._cond.wait(timeout=1.0)
+            if self.error is not None:
+                raise RuntimeError(
+                    f"async send to rank {self._dst} failed"
+                ) from self.error
+            if self._closed:
+                raise RuntimeError("TcpNet finalized")
+            self._frames.append(frame)
+            self._queued_bytes += len(frame)
+            self._cond.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while (self._frames or self._writing) and self.error is None:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise RuntimeError(
+                        f"flush_sends: {self._queued_bytes} bytes to rank "
+                        f"{self._dst} not drained within {timeout}s")
+                self._cond.wait(timeout=1.0 if remaining is None
+                                else min(remaining, 1.0))
+            if self.error is not None:
+                raise RuntimeError(
+                    f"async send to rank {self._dst} failed"
+                ) from self.error
+
+    @property
+    def queued_bytes(self) -> int:
+        with self._cond:
+            return self._queued_bytes
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop accepting frames, drain what is queued, join the thread."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    def _main(self) -> None:
+        while True:
+            with self._cond:
+                while not self._frames and not self._closed:
+                    self._cond.wait()
+                if not self._frames:  # closed and drained
+                    return
+                frame = self._frames.popleft()
+                self._writing = True
+            try:
+                # Same lock order as the blocking path (lock, then
+                # lazy-connect, then pace, then write the whole frame).
+                with self._net._out_locks[self._dst]:
+                    sock = self._net._connect(self._dst)
+                    self._net._pace(len(frame))
+                    with monitor("tcp_send"):
+                        sock.sendall(frame)
+                self._net._count_sent(len(frame))
+            except BaseException as exc:  # noqa: BLE001 - the writer
+                # has no caller to raise into; ANY death (OSError,
+                # MemoryError, ...) must park in self.error and wake
+                # waiters, or submit()/flush() would hang on a silently
+                # dead thread instead of failing loudly.
+                with self._cond:
+                    self.error = exc
+                    self._frames.clear()
+                    self._queued_bytes = 0
+                    self._writing = False
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self._queued_bytes -= len(frame)
+                self._writing = False
+                self._cond.notify_all()
+
+
 class TcpNet(NetInterface):
     """One endpoint of a full-mesh TCP cluster."""
 
@@ -133,9 +257,13 @@ class TcpNet(NetInterface):
         self._inbox: MtQueue = MtQueue()
         self._out: Dict[int, socket.socket] = {}
         self._out_locks = [threading.Lock() for _ in endpoints]
+        self._writers: Dict[int, _PeerWriter] = {}
         self._closed = False
         self._lifecycle = threading.Lock()
         self._readers: List[threading.Thread] = []
+        self._stats_lock = threading.Lock()
+        self._bytes_sent = 0
+        self._wire_free_at = 0.0  # emulated-wire pacing deadline
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -164,13 +292,79 @@ class TcpNet(NetInterface):
         dst = msg.dst
         if not 0 <= dst < self.size:
             raise ValueError(f"bad dst rank {dst}")
+        writer = self._writers.get(dst)
+        if writer is not None:
+            # FIFO with earlier async frames: a sync frame overtaking
+            # queued async ones would reorder the peer's stream.
+            writer.flush(timeout=60.0)
         with monitor("tcp_serialize"):
             frame = _serialize(msg)
         with monitor("tcp_send"):
             with self._out_locks[dst]:
                 sock = self._connect(dst)
+                self._pace(len(frame))
                 sock.sendall(frame)
+        self._count_sent(len(frame))
         return len(frame)
+
+    def send_async(self, msg: Message) -> int:
+        """Queue one serialized frame on the destination's writer thread
+        and return immediately (the non-blocking half of the chunked
+        allreduce pipeline: multiple frames in flight per peer)."""
+        dst = msg.dst
+        if not 0 <= dst < self.size:
+            raise ValueError(f"bad dst rank {dst}")
+        with monitor("tcp_serialize"):
+            frame = _serialize(msg)
+        self._writer(dst).submit(frame)
+        return len(frame)
+
+    def flush_sends(self, dst: Optional[int] = None,
+                    timeout: Optional[float] = None) -> None:
+        writers = [self._writers[dst]] if dst is not None \
+            and dst in self._writers else \
+            (list(self._writers.values()) if dst is None else [])
+        for writer in writers:
+            writer.flush(timeout)
+
+    @property
+    def bytes_sent(self) -> int:
+        with self._stats_lock:
+            return self._bytes_sent
+
+    def _writer(self, dst: int) -> _PeerWriter:
+        writer = self._writers.get(dst)
+        if writer is None:
+            with self._lifecycle:
+                if self._closed:
+                    raise RuntimeError("TcpNet finalized")
+                writer = self._writers.get(dst)
+                if writer is None:
+                    writer = self._writers[dst] = _PeerWriter(self, dst)
+        return writer
+
+    def _count_sent(self, nbytes: int) -> None:
+        with self._stats_lock:
+            self._bytes_sent += nbytes
+
+    def _pace(self, nbytes: int) -> None:
+        """Emulated-wire pacing: one shared outbound link per endpoint,
+        modeled as an absolute busy-until deadline. Each frame reserves
+        its transmission slot and sleeps toward the deadline, so an
+        OVERSLEEP on one frame (common when compute threads load the
+        core) credits the next frame instead of accumulating — without
+        this, many-small-frame paths pay per-sleep scheduler jitter
+        that a few-big-frame path does not, skewing comparisons."""
+        mbps = float(get_flag("net_pace_mbps"))
+        if mbps <= 0:
+            return
+        tx = nbytes * 8.0 / (mbps * 1e6)
+        with self._stats_lock:
+            start = max(time.monotonic(), self._wire_free_at)
+            self._wire_free_at = target = start + tx
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         item = self._inbox.pop(timeout=timeout)
@@ -187,6 +381,27 @@ class TcpNet(NetInterface):
             self._listener.close()
         except OSError:
             pass
+        # Drain + stop the async writers BEFORE the goodbye frames: a
+        # goodbye racing past queued frames would truncate the peer's
+        # stream mid-payload — a ring allreduce returns once it has
+        # RECEIVED everything, so its final-step sends may still be
+        # queued when the caller shuts down, and a peer's collective
+        # depends on them. The drain bound scales with what is queued
+        # (wire-rate paced frames can legitimately take many seconds);
+        # a truly wedged writer is abandoned after that (daemon thread;
+        # the socket close below unblocks any sendall it is stuck in).
+        pace = float(get_flag("net_pace_mbps"))
+        for writer in list(self._writers.values()):
+            pending = writer.queued_bytes
+            drain = 2.0 + pending / (4 << 20)  # ≥4 MB/s of real wire
+            if pace > 0:
+                drain += pending * 8.0 / (pace * 1e6)
+            try:
+                writer.flush(timeout=drain)
+            except RuntimeError:
+                pass
+            writer.close(timeout=2.0)
+        self._writers.clear()
         for dst, sock in list(self._out.items()):
             # Goodbye frame (length 0): tells the peer's reader this
             # close is GRACEFUL, so peer-death detection stays quiet.

@@ -30,6 +30,7 @@ from ..core.message import (Message, MsgType, pack_add_batch,
 from ..util.configure import define_bool, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
+from . import device_lock
 from .actor import Actor
 from .server import Server
 
@@ -118,6 +119,16 @@ class Worker(Actor):
                 if any(b.on_device for b in msg.data) else Server._no_lock
             with lock:
                 partitions = table.partition(msg.data, msg_type)
+                # Multi-zoo mode: per-server device slices must land
+                # before the lock releases (device_lock.py) — an
+                # in-flight slice escaping here overlaps a sibling
+                # rank's server jit and can wedge XLA's CPU pool.
+                # (active() gate: don't build the blob list on the
+                # production hot path, where it can never matter.)
+                if device_lock.active():
+                    device_lock.settle([b.data
+                                        for blobs in partitions.values()
+                                        for b in blobs if b.on_device])
         except Exception as exc:
             # Record the failure on the request and release the caller's
             # waiter — wait() raises instead of returning 'success' over

@@ -209,7 +209,12 @@ def encode_blob(arr, *, lossy: bool = False,
     if idx is not None:
         candidates.append((idx_bytes + nnz * 4, 1, SPARSE_F32))
     if lossy:
-        vals = flat[nonzero]
+        # Dense blobs skip the boolean-mask gather: the eligibility
+        # checks ignore zeros anyway (fp16 looks at the max magnitude,
+        # i8 excludes exact zeros), and flat[nonzero] would copy ~the
+        # whole payload — the dominant encode cost for the allreduce
+        # engine's dense model-average segments.
+        vals = flat if idx is None else flat[idx]
         if _fp16_fits(vals):
             candidates.append((n * 2, 2, DENSE_F16))
             if idx is not None:

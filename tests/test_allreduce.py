@@ -1,0 +1,381 @@
+"""Allreduce engine tests: chunked ring, recursive halving, generation
+tags, error-feedback lossy tiers, failure diagnostics, async transport.
+
+Complements tests/test_collectives.py (which covers the ma-mode public
+API and the device-mesh collectives): this file drives the engine
+directly over LocalFabric virtual ranks and over real localhost TCP
+endpoints, forcing each algorithm via ``-allreduce_algo``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.core.message import Message, MsgType
+from multiverso_tpu.runtime.allreduce_engine import AllreduceEngine
+from multiverso_tpu.runtime.net import LocalFabric
+from multiverso_tpu.util.configure import set_flag
+from multiverso_tpu.util.net_util import free_listen_port
+
+
+def run_ranks(engines, fn, timeout=60):
+    """Run fn(rank, engine) on one thread per engine; returns results."""
+    world = len(engines)
+    results = [None] * world
+    errors = [None] * world
+
+    def body(rank):
+        try:
+            results[rank] = fn(rank, engines[rank])
+        except BaseException as exc:  # noqa: BLE001 - reported below
+            errors[rank] = exc
+
+    threads = [threading.Thread(target=body, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        assert not t.is_alive(), "engine deadlocked"
+    for exc in errors:
+        if exc is not None:
+            raise exc
+    return results
+
+
+def fabric_engines(world):
+    fabric = LocalFabric(world)
+    return [AllreduceEngine(fabric.endpoint(r)) for r in range(world)]
+
+
+def expected_reduce(inputs, reducer):
+    out = inputs[0].copy()
+    for part in inputs[1:]:
+        out = reducer(out, part)
+    return out
+
+
+class TestRingAllreduce:
+    @pytest.mark.parametrize("world", [2, 3, 4, 5, 6])
+    @pytest.mark.parametrize("count", [8, 5000, 40003])
+    def test_matches_numpy(self, world, count):
+        # count=8 still routes through the small/Bruck path (forcing
+        # ring only affects the large path); 40003 is indivisible by
+        # every world size AND the chunk size, so both the chunk and
+        # the per-chunk segment bounds are unequal.
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 16)  # force many chunks
+        set_flag("allreduce_window", 2)
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(0)
+        inputs = [rng.standard_normal(count) for _ in range(world)]
+        expected = np.sum(inputs, axis=0)
+        results = run_ranks(engines,
+                            lambda r, e: e.allreduce(inputs[r]))
+        for out in results:
+            np.testing.assert_allclose(out, expected, rtol=1e-10)
+
+    @pytest.mark.parametrize("world", [3, 5])
+    def test_other_reducer(self, world):
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 16)
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(1)
+        inputs = [rng.standard_normal(9001) for _ in range(world)]
+        expected = expected_reduce(inputs, np.maximum)
+        results = run_ranks(
+            engines, lambda r, e: e.allreduce(inputs[r], np.maximum))
+        for out in results:
+            np.testing.assert_array_equal(out, expected)
+
+    def test_shape_preserved(self):
+        set_flag("allreduce_algo", "ring")
+        engines = fabric_engines(3)
+        inputs = [np.full((50, 40), float(r + 1)) for r in range(3)]
+        results = run_ranks(engines,
+                            lambda r, e: e.allreduce(inputs[r]))
+        for out in results:
+            assert out.shape == (50, 40)
+            np.testing.assert_array_equal(out, np.full((50, 40), 6.0))
+
+    def test_auto_prefers_ring_for_non_pow2(self):
+        engine = fabric_engines(3)[0]
+        assert engine._pick_algo(4 << 20) == "ring"
+        assert engine._pick_algo(32 * 1024) == "ring"  # surplus fold
+        assert engine._pick_algo(5000) == "rhalving"
+
+    def test_auto_prefers_rhalving_for_small_pow2(self):
+        engine = fabric_engines(4)[0]
+        assert engine._pick_algo(5000) == "rhalving"
+        assert engine._pick_algo(4 << 20) == "ring"
+
+
+class TestRecursiveHalving:
+    @pytest.mark.parametrize("world", [3, 5, 6])
+    @pytest.mark.parametrize("reducer", [np.add, np.maximum])
+    def test_non_pow2_worlds(self, world, reducer):
+        # The surplus-fold path, explicitly forced (auto would switch
+        # non-pow2 worlds to the ring at these sizes).
+        set_flag("allreduce_algo", "rhalving")
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(2)
+        inputs = [rng.standard_normal(5003) for _ in range(world)]
+        expected = expected_reduce(inputs, reducer)
+        results = run_ranks(
+            engines, lambda r, e: e.allreduce(inputs[r], reducer))
+        for out in results:
+            np.testing.assert_allclose(out, expected, rtol=1e-10)
+
+    def test_surplus_result_is_private(self):
+        # The surplus rank's result must be its own buffer: in-process
+        # the final frame is a reference to the leader's array, and a
+        # caller mutating its result in place must not corrupt peers.
+        set_flag("allreduce_algo", "rhalving")
+        engines = fabric_engines(3)
+        inputs = [np.full(2000, float(r + 1)) for r in range(3)]
+
+        def body(rank, engine):
+            out = engine.allreduce(inputs[rank])
+            out += rank  # in-place mutation of the returned buffer
+            return out
+
+        results = run_ranks(engines, body)
+        for rank, out in enumerate(results):
+            np.testing.assert_array_equal(out, np.full(2000, 6.0 + rank))
+
+
+class TestGenerationTags:
+    def test_back_to_back_different_round_counts(self):
+        # Regression: tags used to restart at fixed bases (1000/2000),
+        # so consecutive allreduces whose round counts differ could
+        # cross-match stash entries. The per-call generation in the
+        # msg_id high bits makes every sequence safe; run a mix of
+        # small (Bruck), ring, and rhalving payloads back to back on
+        # persistent engines.
+        set_flag("allreduce_algo", "auto")
+        set_flag("allreduce_ring_kb", 16)
+        set_flag("allreduce_chunk_kb", 16)
+        world = 3
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(3)
+        for count in (6000, 41, 12000, 300, 9000, 8, 40000):
+            inputs = [rng.standard_normal(count) for _ in range(world)]
+            expected = np.sum(inputs, axis=0)
+            results = run_ranks(engines,
+                                lambda r, e: e.allreduce(inputs[r]))
+            for out in results:
+                np.testing.assert_allclose(out, expected, rtol=1e-10)
+
+    def test_generation_in_msg_id_high_bits(self):
+        engine = fabric_engines(2)[0]
+        engine._gen = 5
+        assert engine._mid(1000) == (5 << 20) | 1000
+
+
+class TestFailureDiagnostics:
+    def test_timeout_error_carries_context(self):
+        # Peer never shows up: the error must name the peer, the tag,
+        # the elapsed time, the flag to tune, and the stash state —
+        # and must honor -allreduce_timeout_s instead of 120s.
+        set_flag("allreduce_timeout_s", 0.3)
+        fabric = LocalFabric(2)
+        engine = AllreduceEngine(fabric.endpoint(0))
+        start = time.monotonic()
+        with pytest.raises(RuntimeError) as info:
+            engine.allreduce(np.ones(8, np.float32))
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0, "flag-configured timeout not honored"
+        text = str(info.value)
+        for needle in ("peer 1", "msg_id", "allreduce_timeout_s",
+                       "stash"):
+            assert needle in text, (needle, text)
+
+    def test_stash_cap_fails_loudly(self):
+        # A crashed peer (or tag bug) flooding the endpoint with
+        # unmatched frames must trip the cap, not grow the stash
+        # unboundedly until the timeout.
+        set_flag("allreduce_stash_cap", 8)
+        set_flag("allreduce_timeout_s", 30.0)
+        fabric = LocalFabric(2)
+        junk_src = fabric.endpoint(1)
+        for i in range(12):
+            msg = Message(src=1, dst=0, msg_type=MsgType.Default,
+                          msg_id=900000 + i)
+            msg.push(np.zeros(4, np.float32))
+            junk_src.send(msg)
+        engine = AllreduceEngine(fabric.endpoint(0))
+        start = time.monotonic()
+        with pytest.raises(RuntimeError) as info:
+            engine.allreduce(np.ones(8, np.float32))
+        assert time.monotonic() - start < 5.0, "cap did not short-circuit"
+        text = str(info.value)
+        assert "stash exceeded 8" in text
+        assert "allreduce_stash_cap" in text
+
+
+class TestErrorFeedback:
+    def _step_inputs(self, rng, world, n):
+        # Bounded dynamic range so the int8 tier is eligible
+        # (wire_codec._i8_fits) — the shape of normalized gradients.
+        return [(np.sign(rng.standard_normal(n))
+                 * rng.uniform(0.5, 1.5, n)).astype(np.float32)
+                for _ in range(world)]
+
+    def test_residual_corrected_lossy_tracks_lossless(self):
+        # The EQuARX property: per-step quantization error is ~1%, but
+        # with the residual carried across calls the ACCUMULATED sum
+        # tracks the exact one — noise averages out instead of random-
+        # walking. N=200000 fp32 with 64KB chunks puts every segment
+        # over the 4KB codec floor, so the int8/f16 tiers engage.
+        world, steps, n = 3, 20, 200000
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 64)
+        set_flag("allreduce_lossy", True)
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(7)
+        acc = np.zeros(n, np.float64)
+        exact = np.zeros(n, np.float64)
+        per_step_rel = []
+        for _ in range(steps):
+            inputs = self._step_inputs(rng, world, n)
+            step_exact = np.sum([x.astype(np.float64) for x in inputs],
+                                axis=0)
+            exact += step_exact
+            results = run_ranks(engines,
+                                lambda r, e: e.allreduce(inputs[r]))
+            # Lossy results are still bit-identical across ranks: the
+            # allgather forwards each owner's encoded frame verbatim
+            # and the owner adopts its own decoded copy.
+            for out in results[1:]:
+                np.testing.assert_array_equal(out, results[0])
+            acc += results[0].astype(np.float64)
+            per_step_rel.append(
+                float(np.abs(results[0] - step_exact).max()
+                      / np.abs(step_exact).max()))
+        assert engines[0]._ef, "lossy tiers never engaged"
+        assert per_step_rel[0] > 1e-5, \
+            "quantization inactive — the property test is vacuous"
+        rel = float(np.abs(acc - exact).max() / np.abs(exact).max())
+        # Residual-corrected: accumulated error stays ~one step's
+        # quantization noise, far below steps * per-step error.
+        assert rel < 0.02, (rel, per_step_rel)
+        assert rel < 2 * max(per_step_rel), (rel, max(per_step_rel))
+
+    def test_lossless_when_flag_off(self):
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 64)
+        set_flag("allreduce_lossy", False)
+        world, n = 2, 100000
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(8)
+        inputs = self._step_inputs(rng, world, n)
+        expected = inputs[0] + inputs[1]
+        results = run_ranks(engines,
+                            lambda r, e: e.allreduce(inputs[r]))
+        for out in results:
+            np.testing.assert_array_equal(out, expected)
+        assert not engines[0]._ef
+
+    def test_non_add_reducer_stays_exact_under_lossy_flag(self):
+        # Error feedback is an ADDITIVE identity: folding a carried
+        # residual into a max-reduction would corrupt it, so a non-add
+        # reducer must bypass the lossy tier entirely.
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 64)
+        set_flag("allreduce_lossy", True)
+        world, n = 3, 120000
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(11)
+        inputs = self._step_inputs(rng, world, n)
+        expected = expected_reduce(inputs, np.maximum)
+        results = run_ranks(
+            engines, lambda r, e: e.allreduce(inputs[r], np.maximum))
+        for out in results:
+            np.testing.assert_array_equal(out, expected)
+        assert not engines[0]._ef  # quantization never engaged
+
+    def test_small_segments_fall_back_lossless(self):
+        # Segments under the 4KB codec floor must ride exact even with
+        # the lossy flag on (and consume any pending residual exactly).
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 4)  # segments ~1-2KB
+        set_flag("allreduce_lossy", True)
+        world, n = 3, 9000
+        engines = fabric_engines(world)
+        rng = np.random.default_rng(9)
+        inputs = self._step_inputs(rng, world, n)
+        expected = np.sum([x.astype(np.float64) for x in inputs],
+                          axis=0).astype(np.float32)
+        results = run_ranks(engines,
+                            lambda r, e: e.allreduce(inputs[r]))
+        for out in results:
+            np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+class TestTcpAsyncTransport:
+    def _pair(self):
+        eps = [f"127.0.0.1:{free_listen_port()}" for _ in range(2)]
+        from multiverso_tpu.runtime.tcp import TcpNet
+        return [TcpNet(r, eps) for r in range(2)]
+
+    def test_send_async_fifo_and_flush(self):
+        a, b = self._pair()
+        try:
+            for i in range(40):
+                msg = Message(src=0, dst=1, msg_type=MsgType.Default,
+                              msg_id=i)
+                msg.push(np.full(64, float(i), np.float32))
+                a.send_async(msg)
+            a.flush_sends(1, timeout=30)
+            assert a.bytes_sent > 40 * 64 * 4
+            got = [b.recv(timeout=10) for _ in range(40)]
+            assert [m.msg_id for m in got] == list(range(40))
+            np.testing.assert_array_equal(
+                got[7].data[0].as_array(np.float32), np.full(64, 7.0))
+        finally:
+            a.finalize()
+            b.finalize()
+
+    def test_sync_send_ordered_after_async(self):
+        # A blocking send must not overtake queued async frames.
+        a, b = self._pair()
+        try:
+            for i in range(10):
+                msg = Message(src=0, dst=1, msg_type=MsgType.Default,
+                              msg_id=i)
+                msg.push(np.zeros(50000, np.float32))  # non-trivial wire
+                a.send_async(msg)
+            tail = Message(src=0, dst=1, msg_type=MsgType.Default,
+                           msg_id=99)
+            tail.push(np.zeros(4, np.float32))
+            a.send(tail)
+            ids = [b.recv(timeout=10).msg_id for _ in range(11)]
+            assert ids == list(range(10)) + [99]
+        finally:
+            a.finalize()
+            b.finalize()
+
+    def test_ring_allreduce_over_tcp(self):
+        set_flag("allreduce_algo", "ring")
+        set_flag("allreduce_chunk_kb", 64)
+        eps = [f"127.0.0.1:{free_listen_port()}" for _ in range(3)]
+        from multiverso_tpu.runtime.tcp import TcpNet
+        nets = [TcpNet(r, eps) for r in range(3)]
+        try:
+            engines = [AllreduceEngine(n) for n in nets]
+            rng = np.random.default_rng(5)
+            inputs = [rng.standard_normal(120000).astype(np.float32)
+                      for _ in range(3)]
+            expected = np.sum([x.astype(np.float64) for x in inputs],
+                              axis=0)
+            results = run_ranks(engines,
+                                lambda r, e: e.allreduce(inputs[r]),
+                                timeout=90)
+            for out in results:
+                np.testing.assert_allclose(out, expected, rtol=1e-4,
+                                           atol=1e-4)
+        finally:
+            for n in nets:
+                n.finalize()

@@ -10,11 +10,13 @@ import numpy as np
 import pytest
 
 import multiverso_tpu as mv
-from multiverso_tpu.parallel import MASGDStep, allreduce_mesh, \
-    model_average, pmean_mesh, psum_scalar
+from multiverso_tpu.parallel import MAAverager, MASGDStep, \
+    allreduce_mesh, model_average, model_average_async, pmean_mesh, \
+    psum_scalar
 from multiverso_tpu.runtime.allreduce_engine import AllreduceEngine
 from multiverso_tpu.runtime.cluster import LocalCluster
 from multiverso_tpu.runtime.net import LocalFabric
+from multiverso_tpu.util.dashboard import Dashboard
 
 
 class TestAggregate:
@@ -40,6 +42,98 @@ class TestAggregate:
             return model_average(np.full(4, float(rank)))[0]
 
         assert LocalCluster(2, argv=["-ma=true"]).run(body) == [0.5, 0.5]
+
+
+class TestModelAverageAsync:
+    def test_async_matches_sync_bit_identical(self):
+        # The acceptance contract: with -allreduce_lossy off, the
+        # overlapped average returns EXACTLY what the blocking one
+        # does (same collective, same summation order).
+        def body(rank):
+            data = np.full(4096, float(rank + 1), np.float32)
+            sync = model_average(data)
+            fut = model_average_async(data)
+            out = fut.result(timeout=60)
+            np.testing.assert_array_equal(out, sync)
+            return float(out[0])
+
+        outs = LocalCluster(3, argv=["-ma=true"]).run(body)
+        assert outs == [2.0] * 3
+
+    def test_future_snapshots_input(self):
+        # The caller may keep mutating its live buffer while the
+        # average streams — the submitted values are a snapshot.
+        def body(rank):
+            data = np.full(2048, float(rank), np.float32)
+            fut = model_average_async(data)
+            data += 100.0  # must not leak into the collective
+            return float(fut.result(timeout=60)[0])
+
+        assert LocalCluster(2, argv=["-ma=true"]).run(body) == [0.5, 0.5]
+
+    def test_averager_double_buffer_and_delta(self):
+        # submit -> local progress -> collect(current): the result is
+        # avg(snapshots) + local delta, and MA_COMM_STALL only charges
+        # the residual blocked time.
+        def body(rank):
+            avg = MAAverager()
+            params = np.full(1024, float(rank), np.float32)
+            avg.submit(params)
+            params += 2.0  # "training" while the average streams
+            merged = avg.collect(current=params, timeout=60)
+            # avg of (0,1) = 0.5; + local delta 2.0
+            np.testing.assert_allclose(merged, np.full(1024, 2.5))
+            with pytest.raises(RuntimeError):
+                avg.collect()  # nothing in flight anymore
+            return True
+
+        assert LocalCluster(2, argv=["-ma=true"]).run(body) == [True] * 2
+
+    def test_back_to_back_async_run_in_call_order(self):
+        # FIFO ticketing: two async submissions (and a sync call mixed
+        # in) must execute in CALL order on every rank, or same-
+        # generation collectives cross-pair across ranks and silently
+        # average A-data against B-data.
+        def body(rank):
+            a = model_average_async(
+                np.full(2048, float(rank), np.float32))
+            b = model_average_async(
+                np.full(2048, float(rank * 10), np.float32))
+            c = model_average(np.full(2048, float(rank * 100),
+                              np.float32))
+            return (float(a.result(timeout=60)[0]),
+                    float(b.result(timeout=60)[0]), float(c[0]))
+
+        outs = LocalCluster(2, argv=["-ma=true"]).run(body)
+        assert outs == [(0.5, 5.0, 50.0)] * 2
+
+    def test_submit_twice_refused(self):
+        def body(rank):
+            avg = MAAverager()
+            avg.submit(np.ones(8, np.float32))
+            try:
+                avg.submit(np.ones(8, np.float32))
+                return "missing-check"
+            except RuntimeError:
+                pass
+            avg.collect(timeout=60)
+            return "ok"
+
+        assert LocalCluster(2, argv=["-ma=true"]).run(body) == ["ok"] * 2
+
+    def test_comm_stall_monitor_records(self):
+        mon = Dashboard.get("MA_COMM_STALL")
+        before = mon.count
+
+        def body(rank):
+            model_average(np.ones(64, np.float32))
+            fut = model_average_async(np.ones(64, np.float32))
+            fut.result(timeout=60)
+            return True
+
+        LocalCluster(2, argv=["-ma=true"]).run(body)
+        # Every sync call + every blocked result() lands one sample.
+        assert mon.count >= before + 2
 
 
 class TestAllreduceEngine:

@@ -193,6 +193,37 @@ print("MA_OK")
     assert all("MA_OK" in o for o in outs)
 
 
+def test_ma_ring_allreduce_over_tcp(tmp_path):
+    # The chunked pipelined ring path over real OS processes: 3 ranks
+    # (non-power-of-two, so no surplus fold), forced ring with small
+    # chunks so the sliding window and the writer threads actually
+    # carry multiple frames in flight; then the int8 lossy tier with
+    # its error-feedback residual across back-to-back calls.
+    n = 3
+    mf, _ = write_machine_file(tmp_path, n)
+    body = f"""
+mv.init(["-machine_file={mf}", "-rank=" + str(rank), "-ma=true",
+         "-allreduce_algo=ring", "-allreduce_chunk_kb=64"])
+big = mv.aggregate(np.full(300000, 1.0, np.float32) * (rank + 1))
+np.testing.assert_allclose(big, np.full(300000, 6.0), rtol=1e-5)
+rng = np.random.default_rng(rank)
+odd = mv.aggregate(np.arange(120001, dtype=np.float32))
+np.testing.assert_allclose(odd, np.arange(120001) * {n}, rtol=1e-5)
+mv.set_flag("allreduce_lossy", True)
+vals = (np.sign(np.random.default_rng(7).standard_normal(200000))
+        * np.random.default_rng(8).uniform(0.5, 1.5, 200000)
+        ).astype(np.float32)
+lossy = mv.aggregate(vals)
+np.testing.assert_allclose(lossy, vals * {n}, rtol=0.05, atol=0.2)
+lossy2 = mv.aggregate(vals)
+np.testing.assert_allclose(lossy2, vals * {n}, rtol=0.05, atol=0.2)
+mv.shutdown()
+print("MA_RING_OK")
+"""
+    outs = run_cluster([body] * n)
+    assert all("MA_RING_OK" in o for o in outs)
+
+
 def test_aggregate_refused_while_ps_owns_endpoint(tmp_path):
     # Outside ma mode the communicator's recv thread owns the endpoint;
     # a transport-level allreduce would race it for inbound messages, so

@@ -424,6 +424,84 @@ class TestMAWord2Vec:
         assert float(loss2) != float(loss)
 
 
+class TestMACorpusTrainer:
+    def _run(self, tmp_path, overlap):
+        from multiverso_tpu.models.wordembedding import (MACorpusTrainer,
+                                                         TokenizedCorpus)
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        path = tmp_path / "corpus.txt"
+        write_topic_corpus(path, n_sentences=200)
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+
+        def body(rank):
+            config = Word2VecConfig(embedding_size=8, window=2, epochs=2,
+                                    init_learning_rate=0.02,
+                                    batch_size=256, sample=0,
+                                    negative=3, seed=7)
+            model = Word2Vec(config, d)
+            trainer = MACorpusTrainer(model, tok, avg_every=2,
+                                      overlap=overlap,
+                                      centers_per_step=64,
+                                      steps_per_dispatch=1)
+            losses = []
+            for epoch in range(2):
+                loss, examples = trainer.train_epoch(seed=epoch)
+                losses.append(loss / max(examples, 1))
+            trainer.finish()
+            return (np.asarray(model._emb_in).copy(), losses,
+                    trainer.comm_rounds)
+
+        return LocalCluster(2, argv=["-ma=true"]).run(body)
+
+    def test_uneven_shards_with_group_quota(self, tmp_path):
+        # Data-parallel shards of different sizes produce different
+        # group counts per epoch; group_quota (the largest rank's
+        # count) keeps every rank joining the same number of
+        # collectives instead of hanging the longer rank's average.
+        from multiverso_tpu.models.wordembedding import (MACorpusTrainer,
+                                                         TokenizedCorpus)
+        from multiverso_tpu.runtime.cluster import LocalCluster
+        paths = [tmp_path / "a.txt", tmp_path / "b.txt"]
+        write_topic_corpus(paths[0], n_sentences=150)
+        write_topic_corpus(paths[1], n_sentences=60, seed=1)
+        d = Dictionary.build(str(paths[0]), min_count=1)
+        toks = [TokenizedCorpus.build(d, str(p)) for p in paths]
+
+        def body(rank):
+            config = Word2VecConfig(embedding_size=8, window=2, epochs=1,
+                                    init_learning_rate=0.02,
+                                    batch_size=256, sample=0,
+                                    negative=3, seed=5)
+            model = Word2Vec(config, d)
+            trainer = MACorpusTrainer(model, toks[rank], avg_every=2,
+                                      overlap=True, centers_per_step=64,
+                                      steps_per_dispatch=1)
+            trainer.train_epoch(seed=0, group_quota=40)
+            trainer.finish()
+            return (trainer.comm_rounds,
+                    float(np.asarray(model._emb_in).sum()))
+
+        outs = LocalCluster(2, argv=["-ma=true"]).run(body)
+        assert outs[0][0] == outs[1][0]  # same collective count
+        assert abs(outs[0][1] - outs[1][1]) < 1e-5  # replicas agree
+
+    def test_overlap_bit_identical_to_sync_and_trains(self, tmp_path):
+        # The MA overlap acceptance contract: with -allreduce_lossy
+        # off, the double-buffered trainer follows EXACTLY the sync
+        # trainer's trajectory (the average is applied at the same
+        # point in both modes; only where the stall lands differs) —
+        # and the model actually learns.
+        sync = self._run(tmp_path, overlap=False)
+        over = self._run(tmp_path, overlap=True)
+        for rank in range(2):
+            np.testing.assert_array_equal(sync[rank][0], over[rank][0])
+        losses = sync[0][1]
+        assert losses[-1] < losses[0], losses
+        assert sync[0][2] > 0  # averages actually happened
+        assert sync[0][2] == over[0][2]
+
+
 class TestPSDevicePipeline:
     def test_ps_device_pipeline_trains_through_tables(self, tmp_path):
         # The HBM corpus pipeline driving PARAMETER-SERVER tables with
