@@ -5,6 +5,31 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== mvlint static-analysis gate =="
+# Project invariants, machine-checked before anything runs: flag
+# registry, wire-slot registry (cross-checked vs docs/WIRE_FORMAT.md),
+# device-dispatch guarding, lock discipline. Fails on any non-pragma'd
+# violation and prints file:line diagnostics; the trailing summary
+# shows per-pass counts. (`python -m tools.mvlint --baseline ...`
+# prints the same counts WITHOUT failing — drift-at-a-glance for PRs.)
+# See docs/STATIC_ANALYSIS.md.
+python -m tools.mvlint multiverso_tpu tests bench.py
+
+echo "== mvlint self-check (seeded fixtures must still fail) =="
+# The analyzers are regression-protected: a pass that silently stops
+# firing would green-light real violations, so the seeded-violation
+# fixtures must keep exiting with status 1 (violations found) —
+# SPECIFICALLY 1: status 2 means a bad/empty path, i.e. the self-check
+# itself went vacuous (fixtures moved), which must also fail loudly.
+rc=0
+python -m tools.mvlint tools/mvlint/fixtures > /tmp/mv_lint_fix.log 2>&1 \
+    || rc=$?
+if [ "$rc" -ne 1 ]; then
+    cat /tmp/mv_lint_fix.log
+    echo "FATAL: mvlint fixtures self-check expected exit 1, got $rc"
+    exit 1
+fi
+
 echo "== build native (c_api shim) from source =="
 make -C native clean
 make -C native

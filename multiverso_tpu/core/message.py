@@ -94,6 +94,14 @@ class Message:
     def msg_id(self, v: int) -> None:
         self.header[4] = v
 
+    @property
+    def type_int(self) -> int:
+        """The raw type header int. Unlike ``.type`` this never raises
+        on a value outside ``MsgType`` (a newer peer's message type must
+        be loggable/routable as a plain int, not a ValueError) — actor
+        dispatch and wire routing read this."""
+        return self.header[2]
+
     def push(self, blob) -> None:
         if not isinstance(blob, Blob):
             blob = Blob(np.ascontiguousarray(blob))
@@ -155,6 +163,24 @@ def is_wire_encoded(msg: "Message") -> bool:
 # a pre-version peer sends — reads as "unstamped" (-1), never as a real
 # version.
 VERSION_SLOT = 7
+
+
+#: WIRE-SLOT REGISTRY — the single source of truth for the reserved
+#: header slots (5-7). Everything outside this module must index
+#: ``msg.header`` through these names (or the 0-4 property accessors),
+#: never a raw int literal: ``tools/mvlint``'s wire-slot pass enforces
+#: that, and cross-checks this literal against the slot table in
+#: ``docs/WIRE_FORMAT.md`` so the doc cannot silently drift from the
+#: wire. Keep the values literal (the lint parses, it does not import).
+WIRE_SLOTS: dict = {
+    "ERROR_SLOT": 5,
+    "CODEC_SLOT": 6,
+    "VERSION_SLOT": 7,
+}
+
+assert ERROR_SLOT == WIRE_SLOTS["ERROR_SLOT"]
+assert CODEC_SLOT == WIRE_SLOTS["CODEC_SLOT"]
+assert VERSION_SLOT == WIRE_SLOTS["VERSION_SLOT"]
 
 
 def stamp_version(reply: "Message", version: int) -> None:

@@ -518,10 +518,14 @@ class _CorpusOnDevice:
         lengths = np.diff(tokenized.offsets).astype(np.int64)
         sent = np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
         self.n_tokens = int(flat.size)
-        self.flat = jnp.asarray(flat)
-        self.sent = jnp.asarray(sent)
-        self.keep = jnp.asarray(
-            model.dictionary.subsample_keep_prob(config.sample))
+        # One-time host->device uploads; construction can overlap a
+        # sibling rank's step in multi-zoo mode, so guard like any
+        # dispatch (no-op in the one-zoo deployment).
+        with device_lock.guard():
+            self.flat = device_lock.settle(jnp.asarray(flat))
+            self.sent = device_lock.settle(jnp.asarray(sent))
+            self.keep = device_lock.settle(jnp.asarray(
+                model.dictionary.subsample_keep_prob(config.sample)))
 
     def prep_epoch(self, key):
         # Multi-zoo mode (device_lock.py): the prep program is a
@@ -982,9 +986,13 @@ class PSDeviceCorpusTrainer:
             if not hasattr(model, "_points_dev"):
                 # PSWord2Vec keeps the Huffman tables host-side (its
                 # batch path preps row sets on the host); this pipeline
-                # derives paths in-jit, so upload them once.
-                model._points_dev = jnp.asarray(model._points_host)
-                model._codes_dev = jnp.asarray(model._codes_host)
+                # derives paths in-jit, so upload them once (guarded:
+                # construction can overlap a sibling rank's step).
+                with device_lock.guard():
+                    model._points_dev = device_lock.settle(
+                        jnp.asarray(model._points_host))
+                    model._codes_dev = device_lock.settle(
+                        jnp.asarray(model._codes_host))
             path_len = max(int(model._points_host.shape[1]), 1)
             self._C = min(self._C, _hs_center_cap(
                 path_len, int(config.embedding_size)))
@@ -997,9 +1005,13 @@ class PSDeviceCorpusTrainer:
             if not hasattr(model, "_neg_prob_dev"):
                 # PSWord2Vec keeps the alias tables host-side (its batch
                 # path draws negatives on the host); this pipeline
-                # samples in-jit, so upload them once.
-                model._neg_prob_dev = jnp.asarray(model._neg_prob_host)
-                model._neg_alias_dev = jnp.asarray(model._neg_alias_host)
+                # samples in-jit, so upload them once (guarded:
+                # construction can overlap a sibling rank's step).
+                with device_lock.guard():
+                    model._neg_prob_dev = device_lock.settle(
+                        jnp.asarray(model._neg_prob_host))
+                    model._neg_alias_dev = device_lock.settle(
+                        jnp.asarray(model._neg_alias_host))
             B = max(int(getattr(config, "neg_block", 1)), 1)
             if self._C % B:
                 raise ValueError("neg_block must divide centers_per_step")
@@ -1039,10 +1051,13 @@ class PSDeviceCorpusTrainer:
                 or in_table.num_row != out_table.num_row:
             raise ValueError("segment mode expects same-shape in/out "
                              "tables")
-        base = np.int32(0) if self._G == 1 else \
-            jnp.asarray(np.minimum(np.arange(self._G) * self._C,
-                                   max(n_kept, 1)).astype(np.int32))
+        base_host = np.minimum(np.arange(self._G) * self._C,
+                               max(n_kept, 1)).astype(np.int32)
         with device_lock.guard():
+            # The base-vector upload is a dispatch too — keep it inside
+            # the same critical section as the ids program it feeds.
+            base = np.int32(0) if self._G == 1 else \
+                device_lock.settle(jnp.asarray(base_host))
             in_ids, out_ids, _aux = device_lock.settle(self._ids(
                 kept_pad, ksent_pad, self._aux_tables[0],
                 self._aux_tables[1], key, base, n_kept_dev))
@@ -1090,7 +1105,7 @@ class PSDeviceCorpusTrainer:
             step_key = jax.random.fold_in(key, g0)
             if G == 1:
                 base = np.int32(g0 * C)
-                lr = jnp.float32(model.learning_rate())
+                lr_host = np.float32(model.learning_rate())
                 model._account_words(raw_per_step)
             else:
                 # Padded tail blocks get base = n_kept (fully masked)
@@ -1099,12 +1114,19 @@ class PSDeviceCorpusTrainer:
                 bases = np.full(G, n_kept, np.int32)
                 bases[:real] = (np.arange(g0, g0 + real)
                                 * C).astype(np.int32)
-                lrs = np.zeros(G, np.float32)
+                lr_host = np.zeros(G, np.float32)
                 for i in range(real):
-                    lrs[i] = model.learning_rate()
+                    lr_host[i] = model.learning_rate()
                     model._account_words(raw_per_step)
-                base, lr = jnp.asarray(bases), jnp.asarray(lrs)
-            inv_w = jnp.float32(1.0 / model._num_workers)
+            with device_lock.guard():
+                # The per-group scalar/vector uploads are dispatches
+                # too — one guarded region keeps them from interleaving
+                # a sibling rank's program in multi-zoo mode.
+                if G != 1:
+                    base = device_lock.settle(jnp.asarray(bases))
+                lr = device_lock.settle(jnp.asarray(lr_host))
+                inv_w = device_lock.settle(
+                    jnp.float32(1.0 / model._num_workers))
             if self._segment_keys:
                 if self._seg_ids is None:
                     self._build_segment_programs(kept_pad, ksent_pad,

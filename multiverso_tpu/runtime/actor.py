@@ -72,14 +72,14 @@ class Actor:
             self._dispatch(msg)
         except Exception:  # noqa: BLE001
             log.error("actor %s: handling message type %d raised",
-                      self.name, msg.header[2])
+                      self.name, msg.type_int)
             import traceback
             traceback.print_exc()
 
     def _dispatch(self, msg: Message) -> None:
-        handler = self._handlers.get(int(msg.header[2]))
+        handler = self._handlers.get(int(msg.type_int))
         if handler is None:
             log.error("actor %s: unhandled message type %d",
-                      self.name, msg.header[2])
+                      self.name, msg.type_int)
             return
         handler(msg)

@@ -124,7 +124,7 @@ class Communicator(Actor):
 
     # Routing rule (ref: src/communicator.cpp:13-29).
     def _local_forward(self, msg: Message) -> None:
-        msg_type = int(msg.header[2])
+        msg_type = int(msg.type_int)
         if is_server_bound(msg_type):
             self._zoo.route(actors.SERVER, msg)
         elif is_worker_bound(msg_type):

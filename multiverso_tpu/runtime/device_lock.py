@@ -25,16 +25,19 @@ argument untouched — the real deployment keeps full async pipelining.
 from __future__ import annotations
 
 import contextlib
-import threading
+
+from ..util.lock_witness import named_lock, named_rlock
 
 #: The one process-wide device-dispatch lock. ``Server._table_lock`` is
 #: this object (kept as a class attribute for its existing callers).
 #: RLock: the sync server's drain paths re-enter through Server._process_*.
-TABLE_LOCK = threading.RLock()
+#: Witnessed only when -debug_locks is set before this module first
+#: imports (module-level singleton; see util/lock_witness.py).
+TABLE_LOCK = named_rlock("device_lock.TABLE_LOCK")
 
 _NULL = contextlib.nullcontext()
 _serialized = 0  # nesting count of active multi-zoo contexts
-_state_lock = threading.Lock()
+_state_lock = named_lock("device_lock.state")
 
 
 def enable() -> None:

@@ -25,10 +25,10 @@ arrays ride inside Blobs with zero copies).
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
 from ..core.message import Message
+from ..util.lock_witness import named_condition, named_lock
 from ..util.mt_queue import MtQueue
 
 
@@ -142,10 +142,16 @@ class NetInterface:
     # -- per-endpoint collective FIFO --
     def _collective_fifo(self) -> dict:
         # Lazily created; the instance-dict setdefault is atomic under
-        # the GIL.
-        return self.__dict__.setdefault(
-            "_coll_fifo", {"next": 0, "serving": 0,
-                           "cond": threading.Condition()})
+        # the GIL. The fast-path get avoids building a throwaway
+        # dict + Condition per call once initialized (setdefault
+        # evaluates its default eagerly).
+        state = self.__dict__.get("_coll_fifo")
+        if state is None:
+            state = self.__dict__.setdefault(
+                "_coll_fifo",
+                {"next": 0, "serving": 0,
+                 "cond": named_condition(f"{self.name}.collective_fifo")})
+        return state
 
     def reserve_collective_slot(self) -> int:
         """Take the next FIFO ticket on THIS thread. Pass it to a later
@@ -186,11 +192,12 @@ class LocalFabric:
         if size < 1:
             raise ValueError("fabric needs >= 1 rank")
         self._size = size
-        self._inboxes: List[MtQueue] = [MtQueue() for _ in range(size)]
-        self._lock = threading.Lock()
+        self._inboxes: List[MtQueue] = [
+            MtQueue(name=f"fabric.inbox[{r}]") for r in range(size)]
+        self._lock = named_lock("fabric.lock")
         # Shared-memory allreduce state (one in-flight collective at a time,
         # like the reference's serialized MPI_Allreduce).
-        self._ar_cond = threading.Condition()
+        self._ar_cond = named_condition("fabric.allreduce")
         self._ar_parts = {}  # rank -> contribution for the open collective
         self._ar_result = None
         self._ar_generation = 0

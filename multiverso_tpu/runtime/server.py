@@ -29,7 +29,7 @@ from . import actor as actors
 from . import device_lock
 from .actor import Actor
 
-define_double("backup_worker_ratio", 0,
+define_double("backup_worker_ratio", 0.0,
               "reserved: PERCENTAGE of workers treated as backups by the "
               "sync server ('set 20 means 20%' — defined-but-unused in "
               "the reference too, ref: src/server.cpp:21). Parsed as a "

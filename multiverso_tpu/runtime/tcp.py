@@ -42,6 +42,8 @@ from ..util import log
 from ..util.configure import (define_double, define_int, define_string,
                               get_flag)
 from ..util.dashboard import monitor
+from ..util.lock_witness import (acquire_timeout, named_condition,
+                                 named_lock)
 from ..util.mt_queue import MtQueue
 from ..util.net_util import local_addresses
 from .net import NetInterface
@@ -147,7 +149,7 @@ class _PeerWriter:
         self._net = net
         self._dst = dst
         self._frames: collections.deque = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = named_condition(f"tcp[r{net.rank}].writer[d{dst}]")
         self._queued_bytes = 0
         self._writing = False
         self._closed = False
@@ -256,12 +258,13 @@ class TcpNet(NetInterface):
         self._peers = [_parse_endpoint(e, port) for e in endpoints]
         self._inbox: MtQueue = MtQueue()
         self._out: Dict[int, socket.socket] = {}
-        self._out_locks = [threading.Lock() for _ in endpoints]
+        self._out_locks = [named_lock(f"tcp[r{rank}].out[{d}]")
+                           for d in range(len(endpoints))]
         self._writers: Dict[int, _PeerWriter] = {}
         self._closed = False
-        self._lifecycle = threading.Lock()
+        self._lifecycle = named_lock(f"tcp[r{rank}].lifecycle")
         self._readers: List[threading.Thread] = []
-        self._stats_lock = threading.Lock()
+        self._stats_lock = named_lock(f"tcp[r{rank}].stats")
         self._bytes_sent = 0
         self._wire_free_at = 0.0  # emulated-wire pacing deadline
 
@@ -411,8 +414,7 @@ class TcpNet(NetInterface):
             # and bound the send itself: a peer that is alive but not
             # reading (full receive buffer) would otherwise block
             # sendall indefinitely.
-            locked = self._out_locks[dst].acquire(timeout=2.0)
-            try:
+            with acquire_timeout(self._out_locks[dst], 2.0) as locked:
                 if locked:
                     # Without the lock, a goodbye could interleave into a
                     # frame a sender is mid-writing and corrupt the
@@ -427,9 +429,6 @@ class TcpNet(NetInterface):
                     sock.close()
                 except OSError:
                     pass
-            finally:
-                if locked:
-                    self._out_locks[dst].release()
         self._out.clear()
         self._inbox.exit()
 

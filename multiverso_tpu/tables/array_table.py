@@ -28,6 +28,7 @@ import numpy as np
 
 from ..core.blob import Blob, is_device_array
 from ..core.message import MsgType
+from ..runtime import device_lock
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, UpdateEngine, create_rule
 from ..util.log import CHECK
@@ -166,7 +167,10 @@ class ArrayWorker(WorkerTable):
         if len(shards) == 1:
             return shards[0]
         import jax.numpy as jnp
-        return jnp.concatenate(shards)
+        # Worker-thread reassembly dispatch: guarded like any other
+        # multi-device program (multi-zoo mode only; no-op otherwise).
+        with device_lock.guard():
+            return device_lock.settle(jnp.concatenate(shards))
 
     # -- reply (ref: array_table.cpp:95-106) --
     def process_reply_get(self, reply_blobs: List[Blob]) -> None:
@@ -258,7 +262,9 @@ class ArrayServer(ServerTable):
         if padded != self.size:
             values = np.concatenate(
                 [values, np.zeros(padded - self.size, self.dtype)])
-        self._data = jax.device_put(values, self._sharding)
+        with device_lock.guard():
+            self._data = device_lock.settle(
+                jax.device_put(values, self._sharding))
 
     @property
     def raw(self):

@@ -49,13 +49,20 @@ paths.
 
 from __future__ import annotations
 
-import threading
+import itertools
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..util.configure import define_int, get_flag
 from ..util.dashboard import count
+from ..util.lock_witness import named_lock
+
+# Per-INSTANCE witness names: the lock-order graph is keyed by name,
+# so two tables' caches sharing one name would hide real cross-table
+# cycles and manufacture false ones (same reason mt_queue/waiter/tcp
+# use serial/rank names).
+_lock_serial = itertools.count()
 
 define_int("max_get_staleness", 0,
            "client-side parameter-cache staleness bound, in server-shard "
@@ -115,7 +122,8 @@ class VersionTracker:
 
     def __init__(self) -> None:
         self._latest: Dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock(
+            f"client_cache.VersionTracker[{next(_lock_serial)}]")
 
     def note(self, server_id: int, version: int) -> None:
         if version < 0:
@@ -149,7 +157,8 @@ class RowCache:
         self._tracker = tracker
         self._capacity = int(capacity if capacity is not None
                              else get_flag("client_cache_rows"))
-        self._lock = threading.Lock()
+        self._lock = named_lock(
+            f"client_cache.RowCache[{next(_lock_serial)}]")
         self._rows: Dict[int, Tuple[int, np.ndarray]] = {}
         self._floor: Dict[int, int] = {}      # per-row min fetch version
         self._floor_all: Dict[int, int] = {}  # per-server floor
@@ -326,7 +335,8 @@ class BlobCache:
         self._bound = int(bound)
         self._num_servers = int(num_servers)
         self._tracker = tracker
-        self._lock = threading.Lock()
+        self._lock = named_lock(
+            f"client_cache.BlobCache[{next(_lock_serial)}]")
         self._shards: Dict[int, Tuple[int, np.ndarray]] = {}
         self._floor: Dict[int, int] = {}
         self._pending = 0
@@ -417,7 +427,8 @@ class SnapshotCache:
         self._bound = int(bound)
         self._tracker = tracker
         self._capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = named_lock(
+            f"client_cache.SnapshotCache[{next(_lock_serial)}]")
         self._entries: Dict[bytes, Tuple[Dict[int, int], dict]] = {}
         self._floor: Dict[int, int] = {}
         self._pending = 0

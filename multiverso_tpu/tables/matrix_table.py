@@ -40,6 +40,7 @@ import numpy as np
 
 from ..core.blob import Blob, is_device_array
 from ..core.message import MsgType
+from ..runtime import device_lock
 from ..util.dashboard import count as count_event
 from . import client_cache
 from .client_cache import RowCache
@@ -475,10 +476,14 @@ class MatrixWorker(WorkerTable):
         if len(ordered) == 1:
             return ordered[0]
         import jax.numpy as jnp
-        if getattr(self, "_device_sum", False):
-            self._device_sum = False
-            return functools.reduce(jnp.add, ordered)
-        return jnp.concatenate(ordered, axis=0)
+        # Worker-thread reassembly dispatch: guarded like any other
+        # multi-device program (multi-zoo mode only; no-op otherwise).
+        with device_lock.guard():
+            if getattr(self, "_device_sum", False):
+                self._device_sum = False
+                return device_lock.settle(
+                    functools.reduce(jnp.add, ordered))
+            return device_lock.settle(jnp.concatenate(ordered, axis=0))
 
     def get_rows_device_segments_async(self, segments) -> int:
         """Pre-segmented device row pull: ``segments`` is one device id
@@ -862,8 +867,10 @@ class MatrixWorker(WorkerTable):
         # scan; reassembly is the worker's).
         import jax.numpy as jnp
         order = sorted(shards)
-        return (np.concatenate([ids[s] for s in order]),
+        with device_lock.guard():
+            joined = device_lock.settle(
                 jnp.concatenate([shards[s] for s in order], axis=0))
+        return np.concatenate([ids[s] for s in order]), joined
 
     def add_get_dirty_device(self, row_ids, delta,
                              option: Optional[AddOption] = None,
@@ -1105,7 +1112,11 @@ class MatrixServer(ServerTable):
             host = np.zeros((padded, self._col_store), self.dtype)
             host[:self.my_rows, :self.num_col] = rng.uniform(
                 lo, hi, (self.my_rows, self.num_col)).astype(self.dtype)
-            self._data = jax.device_put(host, self._sharding)
+            # Table construction (CreateTable barrier) can overlap a
+            # sibling rank's in-flight program in multi-zoo mode.
+            with device_lock.guard():
+                self._data = device_lock.settle(
+                    jax.device_put(host, self._sharding))
         rule = None if updater_type is None \
             else create_rule(updater_type, dtype)
         num_workers = max(self._zoo.num_workers, 1)
@@ -1245,7 +1256,9 @@ class MatrixServer(ServerTable):
             return _compress_values(np.asarray(values))[0]
         return [Blob(values)]
 
-    def _fused_add_get_dirty(self, blobs: List[Blob]) -> List[Blob]:
+    # Always entered under Server._lock_for (process_add/process_get
+    # server paths) — the guard is one call layer up, not lexical here.
+    def _fused_add_get_dirty(self, blobs: List[Blob]) -> List[Blob]:  # mvlint: ignore[device-dispatch]
         """-4: apply a row add, then reply the get-worker's dirty rows
         gathered from the UPDATED table — ONE compiled program instead
         of the separate scatter + gather pair (whose two big-argument
@@ -1377,7 +1390,9 @@ class MatrixServer(ServerTable):
         padded = self._data.shape[0]
         host = np.zeros((padded, self._col_store), self.dtype)
         host[:self.my_rows, :self.num_col] = values
-        self._data = jax.device_put(host, self._sharding)
+        with device_lock.guard():
+            self._data = device_lock.settle(
+                jax.device_put(host, self._sharding))
 
     @property
     def raw(self):

@@ -19,6 +19,72 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List
 
+#: CENTRAL FLAG REGISTRY — the one canonical (default, description) per
+#: flag name, for the whole tree. ``define_*`` calls scattered across
+#: modules keep working (a flag only becomes *parseable* once its module
+#: imports), but every name and default they register must match this
+#: table: ``tools/mvlint``'s flag-lint pass reads the literal below and
+#: fails CI on any ``get_flag``/``set_flag``/``define_*`` site naming an
+#: unlisted flag or registering a drifted default. Keep the literal
+#: plain (no computed values) — the lint parses it without importing.
+CANONICAL_FLAGS: Dict[str, Any] = {
+    # -- runtime / transport (runtime/tcp.py, runtime/zoo.py) --
+    "machine_file": "",
+    "port": 55555,
+    "rank": -1,
+    "send_queue_mb": 32,
+    "net_pace_mbps": 0.0,
+    "ps_role": "default",
+    "ma": False,
+    "sync": False,
+    # -- server / worker actors --
+    "backup_worker_ratio": 0.0,
+    "coalesce_adds": True,
+    # -- allreduce engine (runtime/allreduce_engine.py) --
+    "allreduce_algo": "auto",
+    "allreduce_chunk_kb": 512,
+    "allreduce_window": 4,
+    "allreduce_ring_kb": 256,
+    "allreduce_timeout_s": 120.0,
+    "allreduce_stash_cap": 4096,
+    "allreduce_lossy": False,
+    # -- wire codec (util/wire_codec.py) --
+    "wire_codec": True,
+    "wire_codec_lossy": False,
+    # -- tables (tables/matrix_table.py, tables/client_cache.py) --
+    "sparse_compress": True,
+    "verify_device_ids": False,
+    "one_bit_push": False,
+    "max_get_staleness": 0,
+    "client_cache_rows": 65536,
+    # -- updater --
+    "updater_type": "default",
+    # -- diagnostics (util/lock_witness.py) --
+    "debug_locks": False,
+    # -- wordembedding model (models/wordembedding/) --
+    "train_file": "",
+    "output_file": "vectors.txt",
+    "vocab_file": "",
+    "save_vocab_file": "",
+    "sw_file": "",
+    "stopwords": "",
+    "size": 100,
+    "window": 5,
+    "negative": 5,
+    "epoch": 1,
+    "min_count": 5,
+    "sample": 1e-3,
+    "init_learning_rate": 0.025,
+    "cbow": False,
+    "hs": False,
+    "use_ps": False,
+    "batch_size": 4096,
+    "neg_block": 1,
+    "per_pair": False,
+    "is_pipeline": True,
+    "device_pipeline": True,
+}
+
 
 class _Flag:
     __slots__ = ("name", "value", "default", "type", "description")
@@ -48,6 +114,20 @@ class FlagRegister:
             return cls._instance
 
     def define(self, name: str, default: Any, description: str = "") -> None:
+        if name in CANONICAL_FLAGS and (
+                default != CANONICAL_FLAGS[name]
+                # Type drift changes coercion semantics even when ==
+                # holds (55555.0 == 55555 but -port would parse float).
+                or type(default) is not type(CANONICAL_FLAGS[name])):
+            # Default drift: two call sites disagree about a flag's
+            # default. mvlint fails CI on this statically; warn loudly
+            # at runtime too (dynamic define paths bypass the lint).
+            from . import log
+            log.error("flag -%s registered with default %r but the "
+                      "canonical default (util/configure.py "
+                      "CANONICAL_FLAGS) is %r — fix the call site or "
+                      "the registry", name, default,
+                      CANONICAL_FLAGS[name])
         if name in self._flags:
             # Re-definition keeps the current value (module reloads in tests).
             return
@@ -113,9 +193,39 @@ def define_double(name: str, default: float, description: str = "") -> None:
     FlagRegister.get().define(name, float(default), description)
 
 
+#: Unknown flag names already warned about (one loud line per process —
+#: a typo'd flag read on a hot path must not flood the log).
+_warned_unknown: set = set()
+
+
+def _warn_unknown_flag(name: str) -> None:
+    """A ``get_flag`` name that is neither registered nor canonical is
+    almost always a typo — and the old behavior (silently return the
+    caller's default) made such typos invisible: the flag the operator
+    set on the command line simply never took effect. Warn ONCE per
+    process per name, with the nearest registered flag (difflib) so the
+    fix is one copy-paste away."""
+    if name in _warned_unknown:
+        return
+    _warned_unknown.add(name)
+    import difflib
+    candidates = set(CANONICAL_FLAGS) | set(FlagRegister.get()._flags)
+    close = difflib.get_close_matches(name, sorted(candidates), n=1)
+    hint = f"; did you mean -{close[0]}?" if close else ""
+    from . import log
+    log.error("get_flag(%r): not a registered or canonical flag — "
+              "returning the caller's default, so -%s=... on the "
+              "command line would be IGNORED%s", name, name, hint)
+
+
 def get_flag(name: str, default: Any = None) -> Any:
     reg = FlagRegister.get()
     if not reg.has(name):
+        # A canonical flag whose defining module simply is not imported
+        # yet reads as its caller default silently (legitimate late
+        # binding); anything else is a likely typo and warns loudly.
+        if name not in CANONICAL_FLAGS:
+            _warn_unknown_flag(name)
         if default is not None:
             return default
         raise KeyError(f"unknown flag: {name}")

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from .lock_witness import named_lock
+
 
 class Monitor:
     def __init__(self, name: str):
@@ -22,7 +24,7 @@ class Monitor:
         self._count = 0
         self._elapsed_ms = 0.0
         self._local = threading.local()  # per-thread begin time
-        self._lock = threading.Lock()
+        self._lock = named_lock(f"dashboard.monitor[{name}]")
 
     def begin(self) -> None:
         self._local.begin = time.perf_counter()
@@ -62,7 +64,9 @@ class Monitor:
 
 class Dashboard:
     _monitors: Dict[str, Monitor] = {}
-    _lock = threading.Lock()
+    # Module-level singleton: witnessed only when -debug_locks was set
+    # before the first dashboard import (util/lock_witness.py).
+    _lock = named_lock("dashboard.registry")
 
     @classmethod
     def get(cls, name: str) -> Monitor:

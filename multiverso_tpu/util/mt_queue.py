@@ -10,18 +10,23 @@ reference's mutex+condvar design.
 from __future__ import annotations
 
 import collections
-import threading
+import itertools
 import time
 from typing import Deque, Generic, Optional, Tuple, TypeVar
 
+from .lock_witness import named_condition, named_lock
+
 T = TypeVar("T")
+
+_serial = itertools.count()
 
 
 class MtQueue(Generic[T]):
-    def __init__(self) -> None:
+    def __init__(self, name: str = "") -> None:
         self._buffer: Deque[T] = collections.deque()
-        self._mutex = threading.Lock()
-        self._cond = threading.Condition(self._mutex)
+        name = name or f"mt_queue[{next(_serial)}]"
+        self._mutex = named_lock(name)
+        self._cond = named_condition(f"{name}.cond", self._mutex)
         self._exit = False
 
     def push(self, item: T) -> None:

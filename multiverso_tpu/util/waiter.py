@@ -7,14 +7,19 @@ TPU-native equivalent of the reference's ``Waiter``
 
 from __future__ import annotations
 
-import threading
+import itertools
 import time
+
+from .lock_witness import named_condition, named_lock
+
+_serial = itertools.count()
 
 
 class Waiter:
-    def __init__(self, num_wait: int = 1):
-        self._mutex = threading.Lock()
-        self._cond = threading.Condition(self._mutex)
+    def __init__(self, num_wait: int = 1, name: str = ""):
+        name = name or f"waiter[{next(_serial)}]"
+        self._mutex = named_lock(name)
+        self._cond = named_condition(f"{name}.cond", self._mutex)
         self._num_wait = num_wait
 
     def wait(self, timeout=None) -> bool:

@@ -1,0 +1,278 @@
+"""Runtime lock-order witness tests (util/lock_witness.py).
+
+The deliberate AB/BA test pins the cycle detector's contract: both
+threads, both locks, and both acquisition stacks are named, and the
+report fires at ACQUIRE time on the second ordering — before any
+actual deadlock can form. The LocalCluster smoke run pins the other
+half of the contract: the real two-rank pipeline is witness-clean.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.util import lock_witness as lw
+from multiverso_tpu.util.configure import set_flag
+
+
+@pytest.fixture(autouse=True)
+def _witness_on():
+    set_flag("debug_locks", True)
+    lw.reset()
+    yield
+    lw.reset()
+    # Witness-era wrappers persist on anything registered process-wide
+    # (Dashboard monitors); drop them so later test modules run on
+    # plain primitives. conftest's _reset_flags restores
+    # debug_locks=False afterwards.
+    from multiverso_tpu.util.dashboard import Dashboard
+    Dashboard.reset()
+
+
+class TestWitnessCore:
+    def test_ab_ba_cycle_fires_with_both_stacks(self):
+        lock_a = lw.named_lock("witness.A")
+        lock_b = lw.named_lock("witness.B")
+        ab_done = threading.Event()
+        caught = []
+
+        def first():  # establishes A -> B
+            with lock_a:
+                with lock_b:
+                    pass
+            ab_done.set()
+
+        def second():  # attempts B -> A: must report, not deadlock
+            ab_done.wait(timeout=5)
+            try:
+                with lock_b:
+                    with lock_a:
+                        pass
+            except lw.LockOrderError as exc:
+                caught.append(str(exc))
+
+        t1 = threading.Thread(target=first, name="wit-first")
+        t2 = threading.Thread(target=second, name="wit-second")
+        t1.start()
+        t2.start()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert caught, "AB/BA ordering did not raise LockOrderError"
+        report = caught[0]
+        # Both locks, both threads, both stacks.
+        assert "witness.A" in report and "witness.B" in report
+        assert "wit-first" in report and "wit-second" in report
+        assert report.count("test_lock_witness.py") >= 2
+        # Also queryable after the fact.
+        assert len(lw.reports()) == 1
+
+    def test_consistent_order_stays_silent(self):
+        lock_a = lw.named_lock("witness.C")
+        lock_b = lw.named_lock("witness.D")
+
+        def worker():
+            for _ in range(50):
+                with lock_a:
+                    with lock_b:
+                        pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert lw.reports() == []
+
+    def test_three_lock_cycle_detected(self):
+        locks = {n: lw.named_lock(f"witness.3{n}") for n in "XYZ"}
+        with locks["X"]:
+            with locks["Y"]:
+                pass
+        with locks["Y"]:
+            with locks["Z"]:
+                pass
+        with pytest.raises(lw.LockOrderError, match="cycle"):
+            with locks["Z"]:
+                with locks["X"]:
+                    pass
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        rlock = lw.named_rlock("witness.R")
+        other = lw.named_lock("witness.R2")
+        with rlock:
+            with rlock:  # re-entrant: no self-edge, no crash
+                with other:
+                    pass
+        assert lw.reports() == []
+
+    def test_rlock_reentry_through_another_lock_is_silent(self):
+        # R -> A -> R (re-entrant) must NOT read as an A -> R ordering
+        # edge closing a cycle with R -> A: the inner acquire is a
+        # re-entry of a lock this thread already holds — exactly the
+        # TABLE_LOCK shape (sync-server drain paths re-enter through
+        # Server._process_* while table helpers take per-cache locks).
+        rlock = lw.named_rlock("witness.R3")
+        other = lw.named_lock("witness.R4")
+        with rlock:
+            with other:
+                with rlock:
+                    pass
+        assert lw.reports() == []
+
+    def test_condition_wait_releases_held_set(self):
+        cond = lw.named_condition("witness.cond")
+        lock = lw.named_lock("witness.cond_peer")
+        woke = []
+
+        def waiter():
+            with cond:
+                woke.append(cond.wait(timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        # Take cond from this thread while the waiter is blocked in
+        # wait(): only possible because wait released the lock; the
+        # held-set must agree or this acquire would record a bogus
+        # edge from the waiter's frame.
+        import time
+        time.sleep(0.1)
+        with lock:
+            with cond:
+                cond.notify_all()
+        t.join(timeout=5)
+        assert woke == [True]
+        assert lw.reports() == []
+
+    # Bare acquire probes below are the point of the test.
+    def test_plain_lock_self_reentry_reports_not_hangs(self):  # mvlint: ignore[lock-discipline]
+        # Re-acquiring a held NON-reentrant lock with an unbounded
+        # blocking acquire is the simplest deadlock there is: the
+        # witness must report it instead of silently hanging.
+        lock = lw.named_lock("witness.self")
+        with lock:
+            with pytest.raises(lw.LockOrderError,
+                               match="self-deadlock"):
+                lock.acquire()
+            # Bounded probes keep their normal failure semantics
+            # (acquire_timeout on a wedged lock must return False,
+            # not raise).
+            assert lock.acquire(timeout=0.05) is False
+            assert lock.acquire(blocking=False) is False
+        assert len(lw.reports()) == 1
+
+    def test_bounded_probe_never_reports_a_cycle(self):
+        # The sanctioned shutdown idiom: after an A->B ordering is on
+        # record, a BOUNDED acquire of A while holding B (tcp.py
+        # finalize's acquire_timeout shape) must fail or succeed
+        # normally — never raise — and must not record a B->A edge.
+        lock_a = lw.named_lock("witness.bnd_A")
+        lock_b = lw.named_lock("witness.bnd_B")
+        with lock_a:
+            with lock_b:
+                pass
+        with lock_b:
+            with lw.acquire_timeout(lock_a, 0.2) as got:
+                assert got  # uncontended: bounded acquire succeeds
+        assert lw.reports() == []
+        # And the full-cycle path is still armed for unbounded
+        # acquires after the probes above.
+        with pytest.raises(lw.LockOrderError):
+            with lock_b:
+                with lock_a:
+                    pass
+
+    def test_wait_without_acquire_does_not_poison_held_set(self):
+        cond = lw.named_condition("witness.unheld")
+        with pytest.raises(RuntimeError):
+            cond.wait(timeout=0.1)  # not acquired: stdlib raises
+        # The failed wait must not leave a phantom held entry that
+        # turns the next legitimate acquire into a self-deadlock.
+        with cond:
+            pass
+        assert lw.reports() == []
+
+    def test_acquire_timeout_helper(self):
+        lock = lw.named_lock("witness.timeout")
+        with lw.acquire_timeout(lock, 1.0) as got:
+            assert got
+            with lw.acquire_timeout(lock, 0.05) as nested:
+                assert not nested  # held: bounded acquire must fail
+        with lw.acquire_timeout(lock, 1.0) as again:
+            assert again  # released on exit despite the failed nest
+
+    def test_client_cache_locks_are_per_instance(self):
+        # The order graph is keyed by NAME: two tables' caches sharing
+        # one name would hide real cross-table cycles and manufacture
+        # false ones.
+        from multiverso_tpu.tables.client_cache import VersionTracker
+        t1, t2 = VersionTracker(), VersionTracker()
+        assert t1._lock.name != t2._lock.name
+
+    def test_disabled_factories_return_plain_primitives(self):
+        set_flag("debug_locks", False)
+        assert isinstance(lw.named_lock("x"), type(threading.Lock()))
+        cond = lw.named_condition("y")
+        assert isinstance(cond, threading.Condition)
+
+
+class TestClusterSmoke:
+    def test_two_rank_table_traffic_stays_silent(self):
+        # -debug_locks on BEFORE the cluster builds its queues/locks:
+        # every MtQueue, Waiter, fabric condition and runtime lock
+        # constructed for the run is witnessed. Plain PS table traffic
+        # must produce zero lock-order reports.
+        import multiverso_tpu as mv
+        from multiverso_tpu.runtime.cluster import LocalCluster
+
+        def body(rank):
+            zoo = mv.current_zoo()
+            table = mv.create_array_table(256)
+            zoo.barrier()
+            for step in range(5):
+                table.add(np.full(256, rank + 1, np.float32))
+                values = table.get()
+                assert values.shape == (256,)
+            zoo.barrier()
+            return float(table.get()[0])
+
+        totals = LocalCluster(2).run(body)
+        assert len(totals) == 2
+        assert lw.reports() == [], lw.reports()
+
+    def test_two_rank_device_pipeline_stays_silent(self, tmp_path):
+        # The PR-4 wedge workload itself: two virtual worker ranks
+        # driving the device-key PS pipeline against one shared server,
+        # under the witness. One epoch is enough to cross every lock
+        # site (mailboxes, waiters, caches, fabric, dispatch guards).
+        import multiverso_tpu as mv
+        from multiverso_tpu.models.wordembedding import (
+            Dictionary, PSDeviceCorpusTrainer, PSWord2Vec,
+            TokenizedCorpus, Word2VecConfig)
+        from multiverso_tpu.runtime.cluster import LocalCluster
+
+        rng = np.random.default_rng(0)
+        words = [f"w{i}" for i in range(16)]
+        path = tmp_path / "corpus.txt"
+        path.write_text("\n".join(
+            " ".join(rng.choice(words, size=12)) for _ in range(120)))
+        d = Dictionary.build(str(path), min_count=1)
+        tok = TokenizedCorpus.build(d, str(path))
+
+        def body(rank):
+            config = Word2VecConfig(embedding_size=8, window=2,
+                                    epochs=1, init_learning_rate=0.01,
+                                    batch_size=512, sample=0)
+            model = PSWord2Vec(config, d)
+            trainer = PSDeviceCorpusTrainer(model, tok,
+                                            centers_per_step=64)
+            loss, pairs = trainer.train_epoch(seed=rank)
+            assert np.isfinite(loss) and pairs > 0
+            mv.current_zoo().barrier()
+            return True
+
+        assert LocalCluster(2, roles=["all", "worker"]).run(body) \
+            == [True, True]
+        assert lw.reports() == [], lw.reports()

@@ -1,0 +1,208 @@
+"""Self-tests for the mvlint static-analysis suite (tools/mvlint).
+
+Each pass runs over a fixture file with seeded violations
+(tools/mvlint/fixtures/) so the analyzers themselves are
+regression-protected: a pass that silently stops firing breaks these
+counts, and a pass that starts over-firing breaks the clean-tree gate.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.mvlint import REPO_ROOT, build_passes, run
+from tools.mvlint.framework import ModuleInfo, run_passes
+from tools.mvlint.wire_slot_lint import WireSlotLint, parse_doc_slots
+
+FIXTURES = Path(__file__).parent.parent / "tools" / "mvlint" / "fixtures"
+
+
+def _fixture_result(name: str):
+    return run_passes(build_passes(REPO_ROOT),
+                      [str(FIXTURES / name)], REPO_ROOT)
+
+
+class TestFixtures:
+    def test_flag_lint_seeded(self):
+        result = _fixture_result("bad_flags.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "flag-lint"]
+        assert len(found) == 4, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        # The typo diagnostic names the nearest real flag.
+        assert "did you mean 'allreduce_window'" in messages
+        assert "default drift" in messages
+        assert "drifts from the canonical default 32" in messages
+        assert result.per_pass_suppressed["flag-lint"] == 1
+
+    def test_wire_slot_seeded(self):
+        result = _fixture_result("bad_wire_slots.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "wire-slot"]
+        assert len(found) == 3, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert "raw header[5]" in messages
+        assert "'MY_SLOT'" in messages
+        assert "computed header index" in messages
+        assert result.per_pass_suppressed["wire-slot"] == 1
+
+    def test_device_dispatch_seeded(self):
+        result = _fixture_result("bad_device_train.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "device-dispatch"]
+        # Exactly the three unguarded eager sites; everything guarded,
+        # traced (decorated / jit-by-name / called-from-traced), or
+        # pragma'd stays silent.
+        assert len(found) == 3, [v.render() for v in found]
+        lines = sorted(v.line for v in found)
+        src = (FIXTURES / "bad_device_train.py").read_text().splitlines()
+        for line in lines:
+            assert "# A" in src[line - 1] or "# B" in src[line - 1] \
+                or "# C" in src[line - 1], src[line - 1]
+        assert result.per_pass_suppressed["device-dispatch"] == 1
+
+    def test_lock_discipline_seeded(self):
+        result = _fixture_result("bad_locks.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "lock-discipline"]
+        assert len(found) == 7, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert "bare .acquire()" in messages
+        assert "bare .release()" in messages
+        assert "blocking call .pop" in messages
+        assert "blocking call .join" in messages
+        assert "blocking call .wait(" in messages
+        # wait_for's mandatory predicate must not read as a timeout.
+        assert "blocking call .wait_for" in messages
+        # socket.recv's bufsize must not read as a timeout either.
+        assert "blocking call .recv" in messages
+        assert result.per_pass_suppressed["lock-discipline"] == 1
+
+    def test_fixture_dir_fails_as_a_whole(self):
+        result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
+                            REPO_ROOT)
+        assert result.failed
+        assert len(result.violations) == 17
+        assert len(result.suppressed) == 4
+
+
+class TestCleanTree:
+    def test_final_tree_is_clean(self):
+        # The acceptance gate: the shipped tree has zero non-pragma'd
+        # violations across all four passes.
+        result = run(("multiverso_tpu", "tests", "bench.py"), REPO_ROOT)
+        assert not result.failed, \
+            "\n".join(v.render() for v in result.violations)
+
+    def test_doc_slot_table_matches_registry(self):
+        doc = parse_doc_slots(REPO_ROOT / "docs" / "WIRE_FORMAT.md")
+        from multiverso_tpu.core.message import WIRE_SLOTS
+        assert doc == WIRE_SLOTS
+
+    def test_doc_drift_is_a_violation(self, tmp_path):
+        drifted = tmp_path / "WIRE_FORMAT.md"
+        drifted.write_text("| 5 | `ERROR_SLOT` |\n"
+                           "| 9 | `CODEC_SLOT` |\n"
+                           "| 7 | `STALE_SLOT` |\n")
+        lint = WireSlotLint({"ERROR_SLOT": 5, "CODEC_SLOT": 6,
+                             "VERSION_SLOT": 7}, drifted)
+        module = ModuleInfo(FIXTURES / "bad_flags.py", REPO_ROOT)
+        findings = [v.message for v in lint.check(module)]
+        assert any("drifted from the wire" in m for m in findings)
+        assert any("VERSION_SLOT=7 missing" in m for m in findings)
+        assert any("stale doc entry" in m for m in findings)
+
+
+class TestFramework:
+    def test_pragma_inside_string_is_inert(self, tmp_path):
+        path = tmp_path / "mod.py"
+        path.write_text(
+            'X = "# mvlint: ignore[flag-lint]"\n'
+            'from multiverso_tpu.util.configure import get_flag\n'
+            'Y = get_flag("not_a_flag_at_all")\n')
+        result = run_passes(build_passes(REPO_ROOT), [str(path)],
+                            tmp_path)
+        assert any(v.pass_name == "flag-lint"
+                   for v in result.violations)
+
+    def test_aliased_lock_is_registered(self, tmp_path):
+        # Server._table_lock = device_lock.TABLE_LOCK carries no
+        # factory call; the alias must still register or server.py's
+        # critical sections go unchecked.
+        path = tmp_path / "mod.py"
+        path.write_text(
+            "from x import device_lock\n"
+            "class S:\n"
+            "    _table_lock = device_lock.TABLE_LOCK\n"
+            "    def bad(self, q):\n"
+            "        with self._table_lock:\n"
+            "            return q.pop()\n")
+        result = run_passes(build_passes(REPO_ROOT), [str(path)],
+                            tmp_path)
+        assert any(v.pass_name == "lock-discipline"
+                   and ".pop" in v.message
+                   for v in result.violations), \
+            [v.render() for v in result.violations]
+
+    def test_doc_drift_not_suppressible_by_module_pragma(self, tmp_path):
+        # Doc findings carry the doc's path; a pragma in whatever file
+        # happens to be scanned first must not swallow them.
+        drifted = tmp_path / "WIRE_FORMAT.md"
+        drifted.write_text("| 9 | `ERROR_SLOT` |\n")
+        mod = tmp_path / "first.py"
+        mod.write_text("X = 1  # mvlint: ignore[wire-slot]\n")
+        lint = WireSlotLint({"ERROR_SLOT": 5}, drifted)
+        result = run_passes([lint], [str(mod)], tmp_path)
+        assert any("drifted from the wire" in v.message
+                   for v in result.violations)
+        assert not result.suppressed
+
+    def test_syntax_error_is_reported_not_crashed(self, tmp_path):
+        path = tmp_path / "broken.py"
+        path.write_text("def oops(:\n")
+        result = run_passes(build_passes(REPO_ROOT), [str(path)],
+                            tmp_path)
+        assert result.failed
+        assert result.violations[0].pass_name == "parse"
+
+
+class TestCli:
+    """The acceptance-criterion entry point, end to end."""
+
+    def test_clean_tree_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mvlint",
+             "multiverso_tpu", "tests", "bench.py"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "mvlint: OK" in proc.stdout
+
+    def test_fixtures_exit_nonzero_with_file_line(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mvlint",
+             "tools/mvlint/fixtures"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        # file:line:col diagnostics
+        assert "tools/mvlint/fixtures/bad_flags.py:18:" in proc.stdout
+        assert "FAILED" in proc.stderr
+
+    def test_nonexistent_path_is_a_hard_error(self):
+        # A drifted path in ci.sh must not let the gate pass vacuously.
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mvlint", "no_such_dir_xyz"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 2
+        assert "no_such_dir_xyz" in proc.stderr
+
+    def test_baseline_mode_never_fails(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mvlint", "--baseline",
+             "tools/mvlint/fixtures"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0
+        assert "violations" in proc.stdout

@@ -75,7 +75,9 @@ class TestNode:
         assert n.rank == -1 and n.role == Role.ALL
 
 
-class TestConfigure:
+# The registry-machinery tests exercise define/get/set on deliberately
+# synthetic flag names — the one place unregistered names are the point.
+class TestConfigure:  # mvlint: ignore[flag-lint]
     def test_parse_cmd_flags(self):
         configure.define_int("test_port", 9999)
         configure.define_bool("test_sync", False)
@@ -107,6 +109,44 @@ class TestConfigure:
         configure.define_int("test_badval", 1)
         with pytest.raises(ValueError, match="test_badval"):
             configure.parse_cmd_flags(["-test_badval=abc"])
+
+    def test_unknown_flag_warns_once_with_suggestion(self, capsys):
+        # A typo'd get_flag must not silently return the caller's
+        # default: one loud line per process, naming the nearest
+        # registered flag (difflib), value still the caller's default.
+        configure._warned_unknown.discard("allreduce_windw")
+        assert configure.get_flag("allreduce_windw", 7) == 7
+        err = capsys.readouterr().err
+        assert "allreduce_windw" in err
+        assert "did you mean -allreduce_window?" in err
+        assert "IGNORED" in err
+        # Second read: same value, no second warning.
+        assert configure.get_flag("allreduce_windw", 7) == 7
+        assert "allreduce_windw" not in capsys.readouterr().err
+
+    def test_canonical_but_unloaded_flag_stays_quiet(self, capsys):
+        # A canonical flag whose defining module is not imported reads
+        # as the caller default silently (legitimate late binding).
+        # 'debug_locks' may already be registered in this process; use
+        # a canonical name guaranteed unregistered via a fresh check.
+        reg = configure.FlagRegister.get()
+        name = next((n for n in configure.CANONICAL_FLAGS
+                     if not reg.has(n)), None)
+        if name is None:
+            pytest.skip("every canonical flag already registered")
+        configure.get_flag(name, configure.CANONICAL_FLAGS[name])
+        assert name not in capsys.readouterr().err
+
+    def test_define_drift_warns(self, capsys):
+        # Registering a canonical flag with a different default is
+        # default drift — mvlint catches it statically, the runtime
+        # warns on dynamic paths.
+        reg = configure.FlagRegister.get()
+        fresh = not reg.has("send_queue_mb")
+        configure.define_int("send_queue_mb", 99)
+        assert "canonical default" in capsys.readouterr().err
+        if fresh:  # don't leave the drifted default behind
+            reg._flags.pop("send_queue_mb", None)
 
 
 class TestMtQueue:

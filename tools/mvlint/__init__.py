@@ -1,0 +1,55 @@
+"""mvlint: project-invariant static analysis for the actor/PS runtime.
+
+Four passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+(see each module's docstring for the precise rules):
+
+* ``flag-lint`` — every flag access names a canonical registered flag
+  with the canonical default (``util/configure.py CANONICAL_FLAGS``).
+* ``wire-slot`` — reserved header slots 5-7 are accessed by registered
+  name only (``core/message.py WIRE_SLOTS``), and the registry matches
+  the slot table in ``docs/WIRE_FORMAT.md``.
+* ``device-dispatch`` — multi-zoo-reachable eager dispatch sits inside
+  a ``device_lock.guard()``-class context (the PR-1/PR-4 XLA wedge).
+* ``lock-discipline`` — registered locks are ``with``-scoped and never
+  lexically wrap a blocking call.
+
+Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
+(``--baseline`` prints per-pass counts without failing). The runtime
+complement — the ``-debug_locks`` lock-order witness — lives in
+``multiverso_tpu/util/lock_witness.py``. Docs:
+``docs/STATIC_ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Sequence
+
+from .device_dispatch_lint import DeviceDispatchLint
+from .flag_lint import FlagLint, load_canonical_flags
+from .framework import LintPass, RunResult, Violation, run_passes
+from .lock_lint import LockDisciplineLint
+from .wire_slot_lint import WireSlotLint, load_wire_slots
+
+#: Repo root = two levels above this package (tools/mvlint/__init__.py).
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+DEFAULT_PATHS = ("multiverso_tpu", "tests", "bench.py")
+
+
+def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
+    canonical = load_canonical_flags(
+        root / "multiverso_tpu" / "util" / "configure.py")
+    slots = load_wire_slots(
+        root / "multiverso_tpu" / "core" / "message.py")
+    return [
+        FlagLint(canonical),
+        WireSlotLint(slots, root / "docs" / "WIRE_FORMAT.md"),
+        DeviceDispatchLint(),
+        LockDisciplineLint(),
+    ]
+
+
+def run(paths: Sequence[str] = DEFAULT_PATHS,
+        root: Path = REPO_ROOT) -> RunResult:
+    return run_passes(build_passes(root), paths, root)

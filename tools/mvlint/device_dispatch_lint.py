@@ -1,0 +1,217 @@
+"""device-dispatch lint: multi-zoo-reachable dispatch sites are guarded.
+
+The PR-1 / PR-4 deadlock class: two threads of one process (sibling
+virtual ranks, or server-vs-trainer) each dispatch a multi-device XLA
+program and wedge the shared CPU execution pool. The mechanical fix is
+``runtime/device_lock.py``: every dispatch site serializes on the ONE
+process lock while multi-zoo mode is active. This pass closes the class
+going forward: in multi-zoo-reachable modules — ``runtime/``,
+``tables/``, ``models/*/device_train.py`` — eager dispatch markers must
+sit lexically inside an accepted guard context.
+
+Dispatch markers:
+
+* ``jax.device_put(...)``
+* eager ``jnp.*(...)`` / ``jax.numpy.*(...)`` calls
+* immediate invocation of a fresh jit: ``jax.jit(f)(x)``
+
+Accepted guards (any enclosing ``with`` item):
+
+* ``device_lock.guard()`` (any alias ending in ``.guard()``)
+* ``self._lock_for(table)`` — the server's table-scoped guard
+* ``_table_lock`` / ``device_lock.TABLE_LOCK`` — the lock object itself
+* a local name bound from one of the above in the same function
+  (``lock = Server._table_lock if ... else ...; with lock:``)
+
+NOT dispatch (skipped):
+
+* bodies of functions/lambdas passed to ``jax.jit``, of functions
+  decorated with a jit, and — by an in-module call-graph closure — of
+  every function a traced function calls: traced code executes under
+  the *caller's* guard, it does not dispatch at its own lexical site.
+  (The closure matches by bare name, which over-approximates toward
+  "traced" on collisions — a lint must err toward silence here; the
+  runtime lock witness backstops what lexical analysis waves through.)
+* ``jax.jit(...)`` itself — building a jitted callable dispatches
+  nothing.
+
+Sites guarded one call layer up (e.g. ``ServerTable.process_*`` bodies,
+always entered under ``Server._lock_for``) are intentional exceptions:
+annotate the ``def`` line with ``# mvlint: ignore[device-dispatch]``
+so the contract is visible where the code is.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .framework import LintPass, ModuleInfo, Violation
+
+SCOPE_MARKERS = ("multiverso_tpu/runtime/", "multiverso_tpu/tables/")
+SCOPE_SUFFIX = "device_train.py"
+SCOPE_EXCLUDE = ("device_lock.py",)
+
+GUARD_TOKENS = (".guard()", "_lock_for(", "_table_lock", "TABLE_LOCK")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.numpy.copy' for nested attribute chains, None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func)
+        if dotted is not None and dotted.split(".")[-1] == "jit":
+            return True
+        # functools.partial(jax.jit, ...) decorators
+        return any(_is_jit_expr(a) for a in node.args)
+    dotted = _dotted(node)
+    return dotted is not None and dotted.split(".")[-1] == "jit"
+
+
+def _dispatch_marker(node: ast.Call) -> Optional[str]:
+    dotted = _dotted(node.func)
+    if dotted is not None:
+        if dotted.endswith("jax.device_put") or dotted == "device_put":
+            return dotted
+        root = dotted.split(".")[0]
+        if root == "jnp" or dotted.startswith("jax.numpy."):
+            return dotted
+    if isinstance(node.func, ast.Call) and _is_jit_expr(node.func):
+        return "jax.jit(...)(...)"  # immediate jit invocation
+    return None
+
+
+def _traced_closure(tree: ast.AST) -> Set[str]:
+    """Names of functions whose bodies are traced, not eagerly run:
+    seeds are jit-decorated defs and names passed to ``*.jit(...)``;
+    the closure adds every function a traced function calls (by bare
+    name, within this module)."""
+    defs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    seeds: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and any(_is_jit_expr(d) for d in node.decorator_list):
+            seeds.add(node.name)
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted is not None and dotted.split(".")[-1] == "jit":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in defs:
+                        seeds.add(arg.id)
+    traced = set()
+    frontier = list(seeds)
+    while frontier:
+        name = frontier.pop()
+        if name in traced:
+            continue
+        traced.add(name)
+        for fn in defs.get(name, ()):
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    callee = None
+                    if isinstance(sub.func, ast.Name):
+                        callee = sub.func.id
+                    elif isinstance(sub.func, ast.Attribute):
+                        callee = sub.func.attr
+                    if callee in defs and callee not in traced:
+                        frontier.append(callee)
+    return traced
+
+
+class DeviceDispatchLint(LintPass):
+    name = "device-dispatch"
+
+    def __init__(self) -> None:
+        self._traced: Set[str] = set()
+
+    def in_scope(self, module: ModuleInfo) -> bool:
+        rel = module.rel
+        if any(rel.endswith(x) for x in SCOPE_EXCLUDE):
+            return False
+        return any(m in rel for m in SCOPE_MARKERS) \
+            or rel.endswith(SCOPE_SUFFIX)
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        if not self.in_scope(module):
+            return
+        self._traced = _traced_closure(module.tree)
+        yield from self._visit(module, module.tree, guarded=False,
+                               func=None)
+
+    def _visit(self, module: ModuleInfo, node: ast.AST, guarded: bool,
+               func: Optional[ast.AST]) -> Iterator[Violation]:
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit_one(module, child, guarded, func)
+
+    def _visit_one(self, module: ModuleInfo, node: ast.AST,
+                   guarded: bool,
+                   func: Optional[ast.AST]) -> Iterator[Violation]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list) \
+                    or node.name in self._traced:
+                return  # traced code: dispatched under the caller's guard
+            yield from self._visit(module, node, guarded=False,
+                                   func=node)
+            return
+        if isinstance(node, ast.Lambda):
+            yield from self._visit(module, node, guarded, func)
+            return
+        if isinstance(node, ast.With):
+            item_guard = guarded or any(
+                self._is_guard(module, item.context_expr, func)
+                for item in node.items)
+            for item in node.items:
+                yield from self._visit_one(module, item.context_expr,
+                                           guarded, func)
+            for stmt in node.body:
+                yield from self._visit_one(module, stmt, item_guard,
+                                           func)
+            return
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted is not None and dotted.split(".")[-1] == "jit":
+                # jax.jit(f) / jax.jit(lambda ...): creation only, and
+                # the argument body is traced code — skip it entirely.
+                return
+            marker = _dispatch_marker(node)
+            if marker is not None and not guarded:
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    f"unguarded device dispatch {marker}(...) in a "
+                    f"multi-zoo-reachable module — wrap the site in "
+                    f"'with device_lock.guard():' (+ settle) or pragma "
+                    f"the enclosing def if the caller holds the lock")
+            yield from self._visit(module, node, guarded, func)
+            return
+        yield from self._visit(module, node, guarded, func)
+
+    def _is_guard(self, module: ModuleInfo, expr: ast.AST,
+                  func: Optional[ast.AST]) -> bool:
+        segment = module.segment(expr)
+        if any(tok in segment for tok in GUARD_TOKENS):
+            return True
+        if isinstance(expr, ast.Name) and func is not None:
+            # 'with lock:' where lock = ..._table_lock... earlier in
+            # the same function.
+            for sub in ast.walk(func):
+                if isinstance(sub, ast.Assign) and sub.value is not None:
+                    for target in sub.targets:
+                        if isinstance(target, ast.Name) \
+                                and target.id == expr.id:
+                            rhs = module.segment(sub.value)
+                            if any(tok in rhs for tok in GUARD_TOKENS):
+                                return True
+        return False

@@ -1,0 +1,54 @@
+"""device-dispatch fixture (filename ends in device_train.py so the
+pass scopes it). Never imported, only parsed.
+
+Expected findings:
+  line A: unguarded jnp dispatch                -> violation
+  line B: unguarded jax.device_put              -> violation
+  line C: unguarded immediate jit invocation    -> violation
+Clean: guarded dispatch (guard() / _lock_for / lock-variable), traced
+function bodies (jit-decorated, jit-by-name, called-from-traced), and
+a whole-def pragma.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.runtime import device_lock
+
+
+def eager_bad(x):
+    a = jnp.concatenate(x)                       # A
+    b = jax.device_put(x)                        # B
+    c = jax.jit(lambda v: v + 1)(x)              # C
+    return a, b, c
+
+
+def eager_guarded(self, x, table):
+    with device_lock.guard():
+        ok1 = device_lock.settle(jnp.concatenate(x))
+    with self._lock_for(table):
+        ok2 = jnp.sum(x)
+    lock = self._table_lock if x else self._no_lock
+    with lock:
+        ok3 = jnp.sum(x)
+    return ok1, ok2, ok3
+
+
+@jax.jit
+def traced_decorated(x):
+    return jnp.sum(x)          # clean: traced
+
+
+def helper(x):
+    return jnp.where(x > 0, x, 0)  # clean: called from traced_by_name
+
+
+def traced_by_name(x):
+    return helper(x) + jnp.sum(x)  # clean: jitted below
+
+
+TRACED = jax.jit(traced_by_name)
+
+
+def caller_holds_lock(x):  # mvlint: ignore[device-dispatch]
+    return jnp.sum(x)          # clean: whole-def pragma

@@ -1,0 +1,26 @@
+"""flag-lint fixture: seeded violations (never imported, only parsed).
+
+Expected findings (tests/test_mvlint.py pins the count):
+  line A: get_flag with a typo'd name           -> violation
+  line B: get_flag with a drifted default       -> violation
+  line C: define_int with a drifted default     -> violation
+  line D: set_flag with an unknown name         -> violation
+  line E: pragma'd unknown name                 -> suppressed (counted)
+Clean lines (no finding): canonical name + canonical default; dynamic
+name expression.
+"""
+
+from multiverso_tpu.util.configure import (define_int, get_flag,
+                                           set_flag)
+
+
+def seeded():
+    a = get_flag("allreduce_windw")                   # A: typo
+    b = get_flag("allreduce_window", 8)               # B: default drift
+    define_int("send_queue_mb", 64)                   # C: define drift
+    set_flag("wire_codec_lossyy", True)               # D: unknown
+    e = get_flag("totally_dynamic_knob")  # mvlint: ignore[flag-lint]
+    ok = get_flag("allreduce_window", 4)              # clean
+    name = "allreduce" + "_window"
+    dyn = get_flag(name)                              # clean (dynamic)
+    return a, b, e, ok, dyn

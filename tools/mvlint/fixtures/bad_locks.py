@@ -1,0 +1,54 @@
+"""lock-discipline fixture: seeded violations (never imported).
+
+Expected findings:
+  line A: bare .acquire() on a registered lock       -> violation
+  line B: bare .release() on a registered lock       -> violation
+  line C: blocking .pop() under a registered lock    -> violation
+  line D: blocking .join() under a registered lock   -> violation
+  line E: foreign condition .wait() under a lock     -> violation
+  line E2: .wait_for(pred) — predicate is NOT a timeout -> violation
+  line E3: sock.recv(n) — bufsize is NOT a timeout   -> violation
+  line F: pragma'd bare acquire                      -> suppressed
+Clean: with-scoped locks, the held condition's own wait, timeouts,
+unregistered objects' acquire/release, and a def nested in a with.
+"""
+
+import threading
+
+from multiverso_tpu.util.lock_witness import named_condition, named_lock
+
+
+class Seeded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = named_condition("fixture.cond")
+        self._other = named_condition("fixture.other")
+        self._pool = [named_lock(f"fixture.pool[{i}]") for i in range(4)]
+
+    def bad(self, queue, thread, sock):
+        self._lock.acquire()                     # A
+        self._lock.release()                     # B
+        with self._cond:
+            item = queue.pop()                   # C
+            thread.join()                        # D
+            self._other.wait()                   # E
+            self._other.wait_for(lambda: item)   # E2
+            data = sock.recv(65536)              # E3
+        self._pool[0].acquire()  # mvlint: ignore[lock-discipline]  (F)
+        return item, data
+
+    def good(self, queue, thread, waiter, net):
+        with self._lock:
+            x = queue.pop(timeout=1.0)
+            y = net.recv(timeout=1.0)            # clean: bounded recv
+        with self._pool[1]:
+            thread.join(timeout=2.0)
+        with self._cond:
+            self._cond.wait(timeout=0.5)
+            self._cond.wait()                    # clean: held cond
+            self._other.wait_for(lambda: 1, 0.5)  # clean: pos. timeout
+        waiter.release()                         # clean: unregistered
+        with self._lock:
+            def later():
+                return queue.pop()               # clean: runs later
+            return x, later

@@ -1,0 +1,23 @@
+"""wire-slot fixture: seeded violations (never imported, only parsed).
+
+Expected findings:
+  line A: raw int index into msg.header         -> violation
+  line B: unregistered name index               -> violation
+  line C: computed index                        -> violation
+  line D: pragma'd raw index                    -> suppressed (counted)
+Clean lines: registered slot names.
+"""
+
+from multiverso_tpu.core.message import CODEC_SLOT, ERROR_SLOT
+
+MY_SLOT = 5
+
+
+def seeded(msg, i):
+    a = msg.header[5]                       # A: raw int
+    b = msg.header[MY_SLOT]                 # B: unregistered name
+    c = msg.header[i + 1]                   # C: computed
+    d = msg.header[2]  # mvlint: ignore[wire-slot]
+    ok1 = msg.header[ERROR_SLOT]            # clean
+    msg.header[CODEC_SLOT] = 1              # clean (store)
+    return a, b, c, d, ok1

@@ -1,0 +1,160 @@
+"""flag-lint: every flag access must name a canonical registered flag.
+
+Source of truth: the ``CANONICAL_FLAGS`` literal in
+``multiverso_tpu/util/configure.py`` (parsed, never imported). Checked
+per scanned file:
+
+* ``get_flag("name"[, default])`` / ``set_flag("name", ...)`` — the
+  literal name must be canonical (catches typo'd ``-allreduce_*`` /
+  ``-wire_codec_*`` / ``-send_queue_mb`` spellings that today silently
+  read the caller's fallback);
+* ``get_flag`` literal defaults and ``define_*("name", default)``
+  registrations must match the canonical default exactly (default
+  drift across call sites);
+* non-literal flag names are skipped (dynamic access is rare and is the
+  caller's responsibility to pragma if it wants the audit trail).
+
+Tree-wide, the pass also emits a **dead-flag report**: canonical flags
+no scanned file ever reads. Informational only — a flag can be consumed
+by an unscanned embedding (and ``backup_worker_ratio`` is reserved,
+defined-but-unread in the reference too).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Set
+
+from .framework import LintPass, ModuleInfo, Violation
+
+DEFINE_FNS = {"define_int", "define_bool", "define_string",
+              "define_double"}
+READ_FNS = {"get_flag", "set_flag"}
+
+
+def load_canonical_flags(configure_path: Path) -> Dict[str, Any]:
+    """The CANONICAL_FLAGS literal, by AST parse of configure.py."""
+    tree = ast.parse(configure_path.read_text(encoding="utf-8"))
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for target in targets:
+            if isinstance(target, ast.Name) \
+                    and target.id == "CANONICAL_FLAGS":
+                value = ast.literal_eval(node.value)
+                if not isinstance(value, dict):
+                    break
+                return value
+    raise RuntimeError(
+        f"no CANONICAL_FLAGS dict literal in {configure_path}")
+
+
+def _literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class FlagLint(LintPass):
+    name = "flag-lint"
+
+    def __init__(self, canonical: Dict[str, Any]):
+        self.canonical = canonical
+        self.read_anywhere: Set[str] = set()
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        if module.path.name == "configure.py" \
+                and "util" in module.path.parts:
+            return  # the registry itself
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _call_name(node)
+            if fn in READ_FNS:
+                yield from self._check_read(module, node, fn)
+            elif fn in DEFINE_FNS:
+                yield from self._check_define(module, node, fn)
+
+    def _check_read(self, module: ModuleInfo, node: ast.Call,
+                    fn: str) -> Iterator[Violation]:
+        if not node.args:
+            return
+        name = _literal_str(node.args[0])
+        if name is None:
+            return  # dynamic name: out of scope
+        self.read_anywhere.add(name)
+        if name not in self.canonical:
+            yield self._unknown(module, node, fn, name)
+            return
+        if fn == "get_flag" and len(node.args) > 1:
+            default = node.args[1]
+            if isinstance(default, ast.Constant) \
+                    and not _matches(default.value,
+                                     self.canonical[name]):
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    f"get_flag({name!r}) falls back to "
+                    f"{default.value!r} but the canonical default is "
+                    f"{self.canonical[name]!r} (util/configure.py "
+                    f"CANONICAL_FLAGS) — default drift")
+
+    def _check_define(self, module: ModuleInfo, node: ast.Call,
+                      fn: str) -> Iterator[Violation]:
+        if not node.args:
+            return
+        name = _literal_str(node.args[0])
+        if name is None:
+            return
+        if name not in self.canonical:
+            yield self._unknown(module, node, fn, name)
+            return
+        if len(node.args) > 1:
+            default = node.args[1]
+            try:
+                value = ast.literal_eval(default)
+            except ValueError:
+                return  # computed default: runtime drift check covers it
+            if not _matches(value, self.canonical[name]):
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    f"{fn}({name!r}, {value!r}) drifts from the "
+                    f"canonical default {self.canonical[name]!r} "
+                    f"(util/configure.py CANONICAL_FLAGS)")
+
+    def _unknown(self, module: ModuleInfo, node: ast.Call, fn: str,
+                 name: str) -> Violation:
+        import difflib
+        close = difflib.get_close_matches(name, sorted(self.canonical),
+                                          n=1)
+        hint = f" — did you mean {close[0]!r}?" if close else ""
+        return Violation(
+            module.rel, node.lineno, node.col_offset, self.name,
+            f"{fn}({name!r}): not in the canonical flag registry "
+            f"(util/configure.py CANONICAL_FLAGS){hint}")
+
+    def tree_report(self) -> List[str]:
+        dead = sorted(set(self.canonical) - self.read_anywhere)
+        if not dead:
+            return []
+        return [f"flag-lint: dead flags (canonical, never read in the "
+                f"scanned tree): {', '.join(dead)}"]
+
+
+def _matches(site_value: Any, canonical: Any) -> bool:
+    """Default equality with type strictness: True != 1, 0 != 0.0 —
+    a drifted TYPE changes coercion semantics even when == holds."""
+    return site_value == canonical \
+        and type(site_value) is type(canonical)

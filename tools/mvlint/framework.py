@@ -1,0 +1,189 @@
+"""mvlint framework: module model, pragma handling, pass protocol, runner.
+
+The project-invariant static analyzer for the actor/PS runtime. Each
+pass is an AST visitor over one :class:`ModuleInfo`; the runner walks
+the requested paths, applies every pass, filters pragma-suppressed
+findings, and renders ``path:line:col: [pass] message`` diagnostics.
+
+Pragma syntax (honored on the violating line, or — for whole-function
+scope — on the ``def``/``class`` line enclosing it):
+
+    something_flagged()  # mvlint: ignore[pass-name]
+    def traced_kernel(x):  # mvlint: ignore[device-dispatch]
+
+Several passes separate with commas: ``# mvlint: ignore[a,b]``.
+Suppressions are counted and shown in the summary — an ignore is an
+annotated exception, not an invisible one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+PRAGMA_RE = re.compile(r"#\s*mvlint:\s*ignore\[([a-z0-9_,\s-]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    pass_name: str
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.pass_name}] {self.message}")
+
+
+class ModuleInfo:
+    """One parsed source file plus its pragma map."""
+
+    def __init__(self, path: Path, root: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix() \
+            if path.is_relative_to(root) else path.as_posix()
+        self.source = path.read_text(encoding="utf-8")
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        #: line -> set of pass names suppressed there ('*' = all)
+        self.pragmas: Dict[int, Set[str]] = {}
+        self._collect_pragmas()
+        #: line ranges suppressed per pass via a pragma on a def/class
+        #: line: pass -> list of (first_line, last_line)
+        self.pragma_spans: Dict[str, List[tuple]] = {}
+        self._collect_spans()
+
+    def _collect_pragmas(self) -> None:
+        # tokenize, not regex-over-lines: '# mvlint: ignore[...]' inside
+        # a string literal must not become a live pragma.
+        try:
+            tokens = tokenize.generate_tokens(
+                iter(self.source.splitlines(keepends=True)).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m:
+                    names = {p.strip() for p in m.group(1).split(",")
+                             if p.strip()}
+                    self.pragmas.setdefault(
+                        tok.start[0], set()).update(names)
+        except tokenize.TokenError:
+            pass
+
+    def _collect_spans(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                continue
+            names = self.pragmas.get(node.lineno, set())
+            if not names:
+                continue
+            span = (node.lineno, node.end_lineno or node.lineno)
+            for name in names:
+                self.pragma_spans.setdefault(name, []).append(span)
+
+    def suppressed(self, violation: Violation) -> bool:
+        names = self.pragmas.get(violation.line, set())
+        if violation.pass_name in names or "*" in names:
+            return True
+        for lo, hi in self.pragma_spans.get(violation.pass_name, []):
+            if lo <= violation.line <= hi:
+                return True
+        for lo, hi in self.pragma_spans.get("*", []):
+            if lo <= violation.line <= hi:
+                return True
+        return False
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+
+class LintPass:
+    """Base: subclass, set ``name``, implement ``check``."""
+
+    name = "base"
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        raise NotImplementedError
+
+    # Tree-wide hook: runs once after every module was scanned, for
+    # cross-file facts (dead flags). Returns informational lines.
+    def tree_report(self) -> List[str]:
+        return []
+
+
+def walk_paths(paths: Sequence[str], root: Path) -> List[Path]:
+    files: List[Path] = []
+    for raw in paths:
+        p = (root / raw) if not Path(raw).is_absolute() else Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py" and p.is_file():
+            files.append(p)
+        else:
+            # A missing/non-.py path must be a hard error: silently
+            # skipping it would let the CI gate pass VACUOUSLY (zero
+            # files scanned -> zero violations) after a rename.
+            raise FileNotFoundError(
+                f"mvlint: {raw!r} is neither a directory nor an "
+                f"existing .py file (resolved to {p})")
+    return files
+
+
+@dataclasses.dataclass
+class RunResult:
+    violations: List[Violation]
+    suppressed: List[Violation]
+    per_pass: Dict[str, int]
+    per_pass_suppressed: Dict[str, int]
+    info: List[str]
+    files_scanned: int
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.violations)
+
+
+def run_passes(passes: Iterable[LintPass], paths: Sequence[str],
+               root: Path) -> RunResult:
+    passes = list(passes)
+    files = walk_paths(paths, root)
+    violations: List[Violation] = []
+    suppressed: List[Violation] = []
+    per_pass = {p.name: 0 for p in passes}
+    per_sup = {p.name: 0 for p in passes}
+    scanned = 0
+    for path in files:
+        try:
+            module = ModuleInfo(path, root)
+        except SyntaxError as exc:
+            violations.append(Violation(
+                str(path), exc.lineno or 0, exc.offset or 0, "parse",
+                f"syntax error: {exc.msg}"))
+            per_pass["parse"] = per_pass.get("parse", 0) + 1
+            continue
+        scanned += 1
+        for lint in passes:
+            for v in lint.check(module):
+                # A pass may report against ANOTHER file (the wire-slot
+                # doc cross-check); only this module's own pragmas may
+                # suppress its own findings.
+                if v.path == module.rel and module.suppressed(v):
+                    suppressed.append(v)
+                    per_sup[lint.name] += 1
+                else:
+                    violations.append(v)
+                    per_pass[lint.name] += 1
+    info: List[str] = []
+    for lint in passes:
+        info.extend(lint.tree_report())
+    violations.sort(key=lambda v: (v.path, v.line, v.col))
+    return RunResult(violations, suppressed, per_pass, per_sup,
+                     info, scanned)

@@ -1,0 +1,196 @@
+"""lock-discipline lint: registered locks use ``with``; no blocking
+call while one is held.
+
+A module "registers" a lock by assigning the result of
+``threading.Lock/RLock/Condition`` or of the witness factories
+``named_lock/named_rlock/named_condition`` (``util/lock_witness.py``)
+to a name — directly or anywhere inside the RHS (list comprehensions
+of per-peer locks count). For every registered name, in that module:
+
+* ``x.acquire(...)`` / ``x.release()`` calls are violations — the
+  ``with`` statement is exception-safe, a bare pair is not. Bounded
+  acquisition on shutdown paths goes through
+  ``lock_witness.acquire_timeout`` (or carries a pragma).
+* Inside ``with x:`` bodies, lexically blocking calls are violations:
+  ``recv_into``/``accept``/``_read_exact``/``select`` always;
+  ``join``/``pop``/``wait`` without a timeout (keyword or first
+  positional); ``recv`` without a ``timeout=`` KEYWORD
+  (``sock.recv(n)``'s positional is a buffer size — socket deadlines
+  come from ``settimeout``); ``wait_for`` without a timeout as keyword
+  or SECOND positional (the mandatory predicate is not a timeout); and
+  the Queue shapes of ``get`` — bare ``q.get()`` / ``q.get(True)`` —
+  while ``d.get(key[, default])`` dict lookups stay clean. EXCEPT
+  ``wait``/``wait_for`` on the very lock object being held (a
+  condition's own wait releases it). A blocking call under a held lock
+  is the raw material of every PS deadlock this repo has shipped.
+
+Nested ``def``/``lambda`` bodies inside a ``with`` are skipped — they
+execute later, not under the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Set
+
+from .framework import LintPass, ModuleInfo, Violation
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition",
+                  "named_lock", "named_rlock", "named_condition"}
+ALWAYS_BLOCKING = {"recv_into", "accept", "_read_exact", "select"}
+TIMEOUT_BLOCKING = {"recv", "join", "get", "pop", "wait", "wait_for"}
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The storage name a lock expression hangs off: ``self._lock`` ->
+    '_lock', ``self._out_locks[dst]`` -> '_out_locks', ``LOCK`` ->
+    'LOCK'."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+_LOCKISH_NAME = re.compile(r"(lock|locks|mutex|cond|condition)$",
+                           re.IGNORECASE)
+
+
+def _makes_lock(rhs: ast.AST) -> bool:
+    for sub in ast.walk(rhs):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name in LOCK_FACTORIES:
+                return True
+        elif isinstance(sub, (ast.Attribute, ast.Name)):
+            # Aliases of existing locks count too — e.g.
+            # ``_table_lock = device_lock.TABLE_LOCK`` — or server.py's
+            # critical sections would go entirely unchecked. A
+            # lock-ish terminal name is the signal.
+            terminal = sub.attr if isinstance(sub, ast.Attribute) \
+                else sub.id
+            if _LOCKISH_NAME.search(terminal):
+                return True
+    return False
+
+
+def _has_timeout(call: ast.Call, method: str) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if method == "wait_for":
+        # wait_for(predicate, timeout): the mandatory predicate is NOT
+        # a timeout — a lone positional still blocks unboundedly.
+        return len(call.args) >= 2
+    if method == "get":
+        # '.get' is overwhelmingly dict/cache lookup (non-blocking);
+        # only the Queue shapes read as blocking: bare q.get() and
+        # q.get(True) (block flag, no timeout).
+        if not call.args:
+            return False
+        return not (len(call.args) == 1
+                    and isinstance(call.args[0], ast.Constant)
+                    and call.args[0].value is True)
+    if method == "recv":
+        # socket.recv(n)'s positional is a BUFFER SIZE, not a timeout
+        # (socket deadlines come from settimeout); only an explicit
+        # timeout= keyword reads as bounded.
+        return False
+    # pop/wait/join carry the timeout first.
+    return bool(call.args)
+
+
+class LockDisciplineLint(LintPass):
+    name = "lock-discipline"
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        if module.path.name == "lock_witness.py":
+            return  # the sanctioned wrapper layer itself
+        registered = self._registered_locks(module)
+        if not registered:
+            return
+        yield from self._scan(module, module.tree, registered, held=[])
+
+    def _registered_locks(self, module: ModuleInfo) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and _makes_lock(node.value):
+                for target in node.targets:
+                    name = _root_name(target)
+                    if name:
+                        names.add(name)
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None \
+                    and _makes_lock(node.value):
+                name = _root_name(node.target)
+                if name:
+                    names.add(name)
+        return names
+
+    def _scan(self, module: ModuleInfo, node: ast.AST,
+              registered: Set[str],
+              held: List[str]) -> Iterator[Violation]:
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan_one(module, child, registered, held)
+
+    def _scan_one(self, module: ModuleInfo, node: ast.AST,
+                  registered: Set[str],
+                  held: List[str]) -> Iterator[Violation]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A def under a with runs later, not under the lock.
+            yield from self._scan(module, node, registered, held=[])
+            return
+        if isinstance(node, ast.With):
+            new_held = list(held)
+            for item in node.items:
+                yield from self._scan_one(module, item.context_expr,
+                                          registered, held)
+                name = _root_name(item.context_expr)
+                if name in registered:
+                    new_held.append(
+                        module.segment(item.context_expr).strip())
+            for stmt in node.body:
+                yield from self._scan_one(module, stmt, registered,
+                                          new_held)
+            return
+        if isinstance(node, ast.Call):
+            yield from self._check_call(module, node, registered, held)
+        yield from self._scan(module, node, registered, held)
+
+    def _check_call(self, module: ModuleInfo, node: ast.Call,
+                    registered: Set[str],
+                    held: List[str]) -> Iterator[Violation]:
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        method = fn.attr
+        receiver = fn.value
+        if method in ("acquire", "release"):
+            name = _root_name(receiver)
+            if name in registered:
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    f"bare .{method}() on registered lock {name!r} — "
+                    f"use 'with' (exception-safe) or "
+                    f"lock_witness.acquire_timeout for bounded "
+                    f"shutdown paths")
+            return
+        if not held:
+            return
+        receiver_src = module.segment(receiver).strip()
+        if method in ("wait", "wait_for") and receiver_src in held:
+            return  # a condition's own wait releases the held lock
+        blocking = method in ALWAYS_BLOCKING or (
+            method in TIMEOUT_BLOCKING and not _has_timeout(node, method))
+        if blocking:
+            yield Violation(
+                module.rel, node.lineno, node.col_offset, self.name,
+                f"blocking call .{method}(...) while holding "
+                f"registered lock(s) {', '.join(held)} — a peer that "
+                f"needs the lock to make this call return deadlocks "
+                f"the process")

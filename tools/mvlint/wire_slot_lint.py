@@ -1,0 +1,128 @@
+"""wire-slot lint: reserved header slots are named, registered, and
+documented.
+
+Rules, everywhere except ``core/message.py`` (the registry itself):
+
+* ``<expr>.header[...]`` may only be indexed by a NAME that appears in
+  the ``WIRE_SLOTS`` registry (``ERROR_SLOT``/``CODEC_SLOT``/
+  ``VERSION_SLOT``). A raw integer index — the PR-3 wire-break class —
+  or any computed index is a violation: slots 0-4 go through the
+  property accessors, 5-7 through their registered names.
+* The slot table in ``docs/WIRE_FORMAT.md`` is cross-checked against
+  the registry: every registered slot must appear in the doc's
+  ``| <n> | `NAME` |`` table with the same number, and vice versa, so
+  the doc cannot silently drift from the wire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional
+
+from .framework import LintPass, ModuleInfo, Violation
+
+DOC_ROW_RE = re.compile(r"^\|\s*(\d+)\s*\|\s*`([A-Z_]+)`\s*\|")
+
+
+def load_wire_slots(message_path: Path) -> Dict[str, int]:
+    """The WIRE_SLOTS literal, by AST parse of core/message.py."""
+    tree = ast.parse(message_path.read_text(encoding="utf-8"))
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == "WIRE_SLOTS":
+                value = ast.literal_eval(node.value)
+                if isinstance(value, dict):
+                    return value
+    raise RuntimeError(f"no WIRE_SLOTS dict literal in {message_path}")
+
+
+def parse_doc_slots(doc_path: Path) -> Dict[str, int]:
+    """``| 5 | `ERROR_SLOT` |`` rows from the doc's slot-registry table."""
+    slots: Dict[str, int] = {}
+    if not doc_path.exists():
+        return slots
+    for line in doc_path.read_text(encoding="utf-8").splitlines():
+        m = DOC_ROW_RE.match(line.strip())
+        if m and m.group(2).endswith("_SLOT"):
+            slots[m.group(2)] = int(m.group(1))
+    return slots
+
+
+class WireSlotLint(LintPass):
+    name = "wire-slot"
+
+    def __init__(self, slots: Dict[str, int], doc_path: Path,
+                 doc_rel: str = "docs/WIRE_FORMAT.md"):
+        self.slots = slots
+        self.doc_path = doc_path
+        self.doc_rel = doc_rel
+        self._doc_checked = False
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        if not self._doc_checked:
+            self._doc_checked = True
+            yield from self._check_doc()
+        if module.path.name == "message.py" \
+                and "core" in module.path.parts:
+            return  # the registry / accessor layer itself
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            base = node.value
+            if not (isinstance(base, ast.Attribute)
+                    and base.attr == "header"):
+                continue
+            index = node.slice
+            if isinstance(index, ast.Name):
+                if index.id in self.slots:
+                    continue
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    f"header indexed by {index.id!r}, which is not a "
+                    f"registered wire slot (core/message.py WIRE_SLOTS: "
+                    f"{', '.join(sorted(self.slots))})")
+            elif isinstance(index, ast.Constant):
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    f"raw header[{index.value!r}] indexing outside "
+                    f"core/message.py — use the src/dst/type/table_id/"
+                    f"msg_id accessors or a registered WIRE_SLOTS name")
+            else:
+                yield Violation(
+                    module.rel, node.lineno, node.col_offset, self.name,
+                    "computed header index outside core/message.py — "
+                    "wire slots must be lexically auditable names")
+
+    def _check_doc(self) -> Iterator[Violation]:
+        doc = parse_doc_slots(self.doc_path)
+        if not self.doc_path.exists():
+            yield Violation(
+                self.doc_rel, 1, 0, self.name,
+                "wire-format doc missing: the slot registry must be "
+                "documented (| <slot> | `NAME` | table)")
+            return
+        for name, slot in sorted(self.slots.items()):
+            if name not in doc:
+                yield Violation(
+                    self.doc_rel, 1, 0, self.name,
+                    f"registered slot {name}={slot} missing from the "
+                    f"doc's slot-registry table (| {slot} | `{name}` |)")
+            elif doc[name] != slot:
+                yield Violation(
+                    self.doc_rel, 1, 0, self.name,
+                    f"doc says {name} is slot {doc[name]} but "
+                    f"core/message.py WIRE_SLOTS says {slot} — the doc "
+                    f"drifted from the wire")
+        for name, slot in sorted(doc.items()):
+            if name not in self.slots:
+                yield Violation(
+                    self.doc_rel, 1, 0, self.name,
+                    f"doc documents slot {name}={slot} which is not in "
+                    f"core/message.py WIRE_SLOTS — stale doc entry")
