@@ -14,10 +14,13 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from .runtime.zoo import Zoo, current_zoo, set_default_zoo, set_thread_zoo
+from .runtime.net import PeerLostError
+from .runtime.zoo import (ClusterAborted, Zoo, current_zoo,
+                          set_default_zoo, set_thread_zoo)
 from .tables import (ArrayTableOption, KVTableOption, MatrixTableOption,
                      create_array_table, create_kv_table,
                      create_matrix_table, create_table)
+from .tables.table_interface import RpcTimeoutError, TableRequestError
 from .updater import AddOption, GetOption
 from .util.configure import set_flag as _set_flag
 

@@ -35,6 +35,20 @@ class MsgType(enum.IntEnum):
     Control_Reply_Barrier = -33
     Control_Register = 34
     Control_Reply_Register = -34
+    # Fault-tolerance control plane (extension — the reference has no
+    # failure detection at all, SURVEY.md section 5.3). Heartbeats ride
+    # the controller band (>32 routes to the controller actor); the
+    # reply and the dead-peer fanout use values below the worker band
+    # (<= -33) and are intercepted by name in the communicator's
+    # routing (they must NOT fall through to the Zoo mailbox, where a
+    # blocked barrier would consume them).
+    Control_Heartbeat = 35
+    Control_Reply_Heartbeat = -35
+    Control_Dead_Peer = -36
+    #: Local-only nudge (HeartbeatMonitor -> controller actor, never
+    #: on the wire): re-check whether a declared-dead rank has
+    #: overstayed -rejoin_grace_s and pending barriers must fail.
+    Control_Check_Barriers = 36
 
 HEADER_SIZE = 8  # ints
 
@@ -144,6 +158,17 @@ def take_error(msg: "Message") -> Optional[str]:
     if msg.data:
         return bytes(msg.data[0].as_array(np.uint8)).decode(errors="replace")
     return "remote table operation failed"
+
+
+#: Marker carried inside error-reply text when the failure is a LOST
+#: PEER rather than table logic: the wire to the serving rank broke, or
+#: the controller declared it dead. Requests failed this way are
+#: RETRYABLE (the peer may restart and rejoin) — ``WorkerTable.wait``
+#: raises ``PeerLostError`` instead of ``TableRequestError`` when the
+#: recorded error carries this marker, and the sync-call retry loop
+#: keys off that type. Travels as plain text so it survives the
+#: mark_error/take_error round trip unchanged across builds.
+PEER_LOST_MARK = "[peer-lost]"
 
 
 # Header slot 6 marks a codec-encoded payload (see util/wire_codec.py):

@@ -16,8 +16,12 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from ..core.message import (Message, is_controller_bound, is_server_bound,
-                            is_wire_encoded, is_worker_bound)
+import numpy as np
+
+from ..core.blob import Blob
+from ..core.message import (PEER_LOST_MARK, Message, MsgType,
+                            is_controller_bound, is_server_bound,
+                            is_wire_encoded, is_worker_bound, mark_error)
 from ..util import log
 from ..util.configure import get_flag
 from ..util.wire_codec import (CAP_WIRE_CODEC, decode_message,
@@ -89,9 +93,67 @@ class Communicator(Actor):
             if self._codec and \
                     self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
                 encode_message(msg)
-            self._net.send(msg)
+            try:
+                self._net.send(msg)
+            except Exception as exc:  # noqa: BLE001 - a dead peer must
+                # not strand the requester's waiter (the actor loop
+                # would only log): synthesize the error reply the peer
+                # can no longer send, so wait() raises a retryable
+                # PeerLostError instead of blocking forever.
+                self._on_send_failed(msg, exc)
         else:
             self._local_forward(msg)
+
+    def _on_send_failed(self, msg: Message, exc: BaseException) -> None:
+        log.error("rank %d: send of %r to rank %d failed: %s",
+                  self._zoo.rank, msg, msg.dst, exc)
+        reason = f"{PEER_LOST_MARK} rank {msg.dst} unreachable: {exc}"
+        reply = self._synth_error_reply(msg, reason)
+        if reply is not None:
+            self._local_forward(reply)
+            return
+        # Control traffic (or a reply toward the dead peer): nothing to
+        # synthesize locally — report the peer so the zoo can decide
+        # (abort, or fail that rank's in-flight work).
+        self._zoo.peer_lost(msg.dst, f"send failed: {exc}")
+
+    def _synth_error_reply(self, msg: Message,
+                           reason: str) -> Optional[Message]:
+        """The error reply a request's server can no longer (or not
+        yet) send, built locally so the requester's waiter completes
+        with a retryable failure instead of hanging. None for
+        non-request messages."""
+        msg_type = msg.type_int
+        if msg_type in (int(MsgType.Request_Get), int(MsgType.Request_Add)):
+            reply = msg.create_reply_message()
+            mark_error(reply, RuntimeError(reason))
+            return reply
+        if msg_type == int(MsgType.Request_BatchAdd):
+            # Per-sub failed acks from the request's own descriptor
+            # (blob 0: [n, (table_id, msg_id, n_blobs)...]) — a
+            # whole-batch error reply would make the worker abort every
+            # table, which is the wrong severity for a retryable peer
+            # loss.
+            reply = msg.create_reply_message()
+            try:
+                req = msg.data[0].as_array(np.int32)
+                desc = [int(req[0])]
+                text = np.frombuffer(reason.encode(errors="replace"),
+                                     np.uint8).copy()
+                err_blobs = []
+                for i in range(int(req[0])):
+                    desc.extend((int(req[1 + 3 * i]), int(req[2 + 3 * i]),
+                                 1, -1))
+                    err_blobs.append(Blob(text.copy()))
+                reply.push(Blob(np.asarray(desc, dtype=np.int32)))
+                reply.data.extend(err_blobs)
+            except Exception:  # noqa: BLE001 - undecodable batch (e.g.
+                # already codec-encoded): fall back to the whole-batch
+                # error; the worker's loud-abort path is still better
+                # than a silent hang.
+                mark_error(reply, RuntimeError(reason))
+            return reply
+        return None
 
     # Inbound path: wire -> local actor mailboxes
     # (ref: src/communicator.cpp:77-91).
@@ -101,6 +163,11 @@ class Communicator(Actor):
             msg = self._net.recv()
             if msg is None:
                 break
+            # Traffic from a declared-dead rank means its restarted
+            # process is back: clear the death mark so a SECOND death
+            # of the same rank is reported fresh (peer_lost dedups on
+            # the mark) — cheap set probe on the common path.
+            self._zoo.notice_peer_alive(msg.src)
             if is_wire_encoded(msg):
                 if not codec_in:
                     # A peer encoded toward a rank that never advertised
@@ -125,8 +192,36 @@ class Communicator(Actor):
     # Routing rule (ref: src/communicator.cpp:13-29).
     def _local_forward(self, msg: Message) -> None:
         msg_type = int(msg.type_int)
+        # Fault-tolerance control frames are intercepted BY NAME before
+        # the band rules: both are < -32, so the fallthrough would park
+        # them in the Zoo mailbox where a blocked barrier() would
+        # consume them and trip its reply-type assert.
+        if msg_type == int(MsgType.Control_Reply_Heartbeat):
+            self._zoo.note_controller_alive()
+            return
+        if msg_type == int(MsgType.Control_Dead_Peer):
+            dead = int(msg.data[0].as_array(np.int32)[0]) if msg.data \
+                else -1
+            self._zoo.peer_lost(dead, "declared dead by the controller's "
+                                      "liveness monitor")
+            return
         if is_server_bound(msg_type):
-            self._zoo.route(actors.SERVER, msg)
+            try:
+                self._zoo.route(actors.SERVER, msg)
+            except RuntimeError as exc:
+                # A REJOINING restarted rank serves its communicator
+                # before its server actor and tables exist; a request
+                # landing in that window must NACK retryably (the
+                # requester backs off and re-issues), not vanish into a
+                # log line while its waiter blocks forever.
+                reply = self._synth_error_reply(
+                    msg, f"{PEER_LOST_MARK} rank {self._zoo.rank}: "
+                         f"server not ready ({exc})")
+                if reply is None:
+                    raise
+                log.error("rank %d: NACKing %r — server actor not "
+                          "ready", self._zoo.rank, msg)
+                self._dispatch(reply)
         elif is_worker_bound(msg_type):
             self._zoo.route(actors.WORKER, msg)
         elif is_controller_bound(msg_type):

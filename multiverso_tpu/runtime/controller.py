@@ -1,4 +1,4 @@
-"""Rank-0 coordination actor: registration + barrier.
+"""Rank-0 coordination actor: registration + barrier + liveness.
 
 TPU-native equivalent of the reference's ``Controller``
 (ref: include/multiverso/controller.h:9-22, src/controller.cpp:12-104).
@@ -10,19 +10,63 @@ Two sub-controllers:
   declared role) per rank, assigns dense worker_id/server_id in rank order,
   then broadcasts the full node table + counts to every rank
   (ref: src/controller.cpp:38-80).
+
+Fault-tolerance extensions (absent in the reference, SURVEY.md 5.3):
+
+- **rejoin handshake**: once the initial registration round has
+  broadcast, a later ``Control_Register`` from an already-known rank is
+  a RESTARTED process re-registering (``-rejoin=true`` on its command
+  line skips the start barrier). It gets an immediate solo reply with
+  the stored node table, and its liveness record is reset.
+- **liveness**: every control message a rank sends (register, barrier,
+  heartbeat) refreshes its last-seen stamp. With
+  ``-heartbeat_interval_s > 0`` each rank runs a ``HeartbeatMonitor``
+  thread that pings the controller; the controller's monitor declares a
+  rank dead after ``-heartbeat_timeout_s`` of silence and fans a
+  ``Control_Dead_Peer`` notice out to the survivors, whose zoos fail
+  that rank's in-flight requests with a retryable ``PeerLostError``.
 """
 
 from __future__ import annotations
 
-from typing import List
+import threading
+import time
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.blob import Blob
-from ..core.message import Message, MsgType
+from ..core.message import (PEER_LOST_MARK, Message, MsgType,
+                            mark_error)
 from ..core.node import Node, is_server, is_worker
+from ..util import log
+from ..util.configure import define_double, get_flag
+from ..util.lock_witness import named_condition, named_lock
 from . import actor as actors
 from .actor import Actor
+from .net import PeerLostError
+
+define_double("heartbeat_interval_s", 0.0,
+              "liveness heartbeat period: every rank pings the "
+              "controller at this interval and the controller declares "
+              "silent ranks dead (fanning Control_Dead_Peer out to the "
+              "survivors). 0 (default) disables the monitor — crash "
+              "detection then rests on the transport's broken-"
+              "connection reporting alone")
+define_double("heartbeat_timeout_s", 5.0,
+              "a rank silent (no register/barrier/heartbeat traffic) "
+              "for this long is declared dead by the controller's "
+              "liveness monitor; survivors fail its in-flight requests "
+              "with PeerLostError. Must comfortably exceed "
+              "-heartbeat_interval_s")
+define_double("rejoin_grace_s", 30.0,
+              "how long a declared-dead rank may stay gone before the "
+              "controller fails PENDING BARRIERS with a retryable "
+              "PeerLostError (a barrier can never complete without the "
+              "dead rank, and without this bound the survivors would "
+              "block in barrier() forever when the rank never "
+              "restarts). A rejoin within the grace clears the timer "
+              "and the parked barrier completes normally")
 
 
 class Controller(Actor):
@@ -30,11 +74,118 @@ class Controller(Actor):
         super().__init__(actors.CONTROLLER, zoo)
         self._barrier_waiting: List[Message] = []
         self._register_waiting: List[Message] = []
+        # Frozen after the initial registration round broadcasts; a
+        # late register (rejoin) replies from this immediately.
+        self._node_reply: Optional[tuple] = None
+        # Liveness: last control traffic per rank (controller-actor
+        # thread writes, the HeartbeatMonitor thread reads — guarded by
+        # _live_lock; only dict/scalar ops run under it).
+        self._live_lock = named_lock(f"controller[r{zoo.rank}].liveness")
+        self._last_seen: Dict[int, float] = {}
+        self._declared_dead: set = set()
+        self._dead_since: Dict[int, float] = {}
         self.register_handler(MsgType.Control_Barrier, self._process_barrier)
         self.register_handler(MsgType.Control_Register, self._process_register)
+        self.register_handler(MsgType.Control_Heartbeat,
+                              self._process_heartbeat)
+        self.register_handler(MsgType.Control_Check_Barriers,
+                              self._process_check_barriers)
+
+    # -- liveness bookkeeping --
+    def _note_alive(self, rank: int) -> None:
+        with self._live_lock:
+            self._last_seen[rank] = time.monotonic()
+            self._declared_dead.discard(rank)
+            self._dead_since.pop(rank, None)
+
+    def silent_ranks(self, timeout: float) -> List[int]:
+        """Ranks not heard from within ``timeout`` and not yet declared
+        dead; marks them declared so each death fans out once (a rejoin
+        register clears the mark)."""
+        now = time.monotonic()
+        stale = []
+        with self._live_lock:
+            for rank, seen in self._last_seen.items():
+                if (now - seen > timeout and rank != self._zoo.rank
+                        and rank not in self._declared_dead):
+                    self._declared_dead.add(rank)
+                    self._dead_since[rank] = now
+                    stale.append(rank)
+        return stale
+
+    def expired_dead_ranks(self, grace: float) -> List[int]:
+        """Declared-dead ranks gone longer than ``grace`` without
+        re-registering (HeartbeatMonitor thread; read-only)."""
+        now = time.monotonic()
+        with self._live_lock:
+            return [rank for rank, since in self._dead_since.items()
+                    if now - since > grace]
+
+    def _process_check_barriers(self, msg: Message) -> None:
+        """Monitor-thread nudge (runs HERE on the actor thread, which
+        owns ``_barrier_waiting``): fail the pending barrier round when
+        a declared-dead rank has overstayed -rejoin_grace_s — the round
+        can never complete without it, and the parked ranks would
+        otherwise block forever. Each parked entry gets an error reply
+        whose text carries PEER_LOST_MARK, so ``zoo.barrier()`` raises
+        a retryable PeerLostError (a later rejoin lets the next
+        barrier succeed)."""
+        if not self._barrier_waiting:
+            return
+        grace = float(get_flag("rejoin_grace_s"))
+        expired = self.expired_dead_ranks(grace)
+        if not expired:
+            return
+        parked = self._barrier_waiting
+        self._barrier_waiting = []
+        log.error("controller: failing a %d-entry barrier round — "
+                  "rank(s) %s dead for more than %.1fs without "
+                  "rejoining", len(parked), expired, grace)
+        for request in parked:
+            reply = request.create_reply_message()
+            mark_error(reply, PeerLostError(
+                f"{PEER_LOST_MARK} barrier cannot complete: rank(s) "
+                f"{expired} declared dead and absent past "
+                f"-rejoin_grace_s={grace}"))
+            self.send_to(actors.COMMUNICATOR, reply)
+
+    def _process_heartbeat(self, msg: Message) -> None:
+        self._note_alive(msg.src)
+        reply = msg.create_reply_message()
+        # The reply is the sender's only proof the controller lives —
+        # it must NOT queue in the communicator mailbox, whose dispatch
+        # thread can park in a -connect_timeout_s connect-retry toward
+        # a dead peer (on a combined controller+worker rank): starved
+        # replies make every healthy rank conclude the controller died
+        # and abort. send_async hands the frame to the destination's
+        # own writer thread, so one unreachable peer cannot delay the
+        # others' replies either (see HeartbeatMonitor._tick).
+        try:
+            self._zoo.net.send_async(reply)
+        except Exception as exc:  # noqa: BLE001 - an unreachable
+            # sender will re-heartbeat or be declared dead; never let
+            # its failure kill the controller actor.
+            log.debug("controller: heartbeat reply to rank %d failed: "
+                      "%s", msg.src, exc)
 
     def _process_barrier(self, msg: Message) -> None:
+        self._note_alive(msg.src)
+        # One pending barrier per RANK: barrier() blocks until its
+        # reply, so a second entry from the same rank means the rank
+        # died mid-barrier and its restarted process is barriering
+        # again — the stale entry must be REPLACED, or it would pair a
+        # future barrier with a ghost and release the cluster early
+        # (observed: a SIGKILLed server's parked shutdown barrier
+        # matching its replacement's, completing a 2-rank barrier with
+        # two rank-1 entries and zero rank-0 ones).
+        stale = [m for m in self._barrier_waiting if m.src == msg.src]
+        for m in stale:
+            self._barrier_waiting.remove(m)
+            log.error("controller: dropping stale barrier entry from "
+                      "rank %d (rank re-entered the barrier)", m.src)
         self._barrier_waiting.append(msg)
+        log.debug("controller: barrier %d/%d (+rank %d)",
+                  len(self._barrier_waiting), self._zoo.net_size, msg.src)
         if len(self._barrier_waiting) == self._zoo.net_size:
             for request in self._barrier_waiting:
                 self.send_to(actors.COMMUNICATOR,
@@ -42,6 +193,28 @@ class Controller(Actor):
             self._barrier_waiting = []
 
     def _process_register(self, msg: Message) -> None:
+        self._note_alive(msg.src)
+        if self._node_reply is not None:
+            # Rejoin handshake: the cluster is already registered — this
+            # is a restarted process re-announcing itself. Solo reply
+            # with the frozen table; waiting for net_size registrations
+            # again would hang both sides.
+            reg = msg.data[0].as_array(np.int32)
+            log.info("controller: rank %d re-registered (rejoin)",
+                     int(reg[0]))
+            # The dead predecessor may have left a barrier entry
+            # parked here (e.g. killed during its shutdown barrier);
+            # purge it so the restarted rank's next barrier cannot
+            # pair with a ghost.
+            self._barrier_waiting = [m for m in self._barrier_waiting
+                                     if m.src != msg.src]
+            table, counts, caps = self._node_reply
+            reply = msg.create_reply_message()
+            reply.push(Blob(table.copy()))
+            reply.push(Blob(counts.copy()))
+            reply.push(Blob(caps.copy()))
+            self.send_to(actors.COMMUNICATOR, reply)
+            return
         self._register_waiting.append(msg)
         if len(self._register_waiting) != self._zoo.net_size:
             return
@@ -69,6 +242,7 @@ class Controller(Actor):
             [[n.rank, n.role, n.worker_id, n.server_id] for n in nodes],
             dtype=np.int32)
         counts = np.array([num_workers, num_servers], dtype=np.int32)
+        self._node_reply = (table, counts, caps)
         for request in self._register_waiting:
             reply = request.create_reply_message()
             reply.push(Blob(table.copy()))
@@ -76,3 +250,124 @@ class Controller(Actor):
             reply.push(Blob(caps.copy()))
             self.send_to(actors.COMMUNICATOR, reply)
         self._register_waiting = []
+
+
+class HeartbeatMonitor:
+    """Per-rank liveness thread (enabled by ``-heartbeat_interval_s``).
+
+    Every rank pings the controller each interval. On the controller
+    rank the same thread scans the controller's last-seen table and
+    fans ``Control_Dead_Peer`` out to the survivors for each newly
+    silent rank; on other ranks it watches for heartbeat REPLIES and
+    reports the controller itself dead after the timeout (a dead
+    controller is unrecoverable — every barrier and registration runs
+    through it — so the zoo aborts)."""
+
+    def __init__(self, zoo) -> None:
+        self._zoo = zoo
+        self._interval = float(get_flag("heartbeat_interval_s"))
+        self._timeout = max(float(get_flag("heartbeat_timeout_s")),
+                            self._interval * 2)
+        self._stop_cond = named_condition(
+            f"heartbeat[r{zoo.rank}].stop")
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._main, daemon=True,
+            name=f"mv-heartbeat-r{self._zoo.rank}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._stop_cond:
+            self._stopped = True
+            self._stop_cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _main(self) -> None:
+        from .zoo import CONTROLLER_RANK
+        while True:
+            with self._stop_cond:
+                if self._stopped:
+                    return
+                self._stop_cond.wait(timeout=self._interval)
+                if self._stopped:
+                    return
+            try:
+                self._tick(CONTROLLER_RANK)
+            except Exception:  # noqa: BLE001 - a monitor hiccup (e.g.
+                # teardown race) must not kill liveness for the run
+                log.debug("heartbeat monitor tick failed on rank %d",
+                          self._zoo.rank)
+
+    def _tick(self, controller_rank: int) -> None:
+        # Liveness traffic goes DIRECTLY over the net from this thread
+        # via send_async, never through the communicator's actor
+        # mailbox: its single dispatch thread can park for up to
+        # -connect_timeout_s in a blocking connect-retry toward a
+        # dead/restarting peer, and a heartbeat queued behind that
+        # starves past -heartbeat_timeout_s — the controller would then
+        # declare this perfectly healthy rank dead, cascading one crash
+        # into false death declarations. send_async (non-blocking,
+        # per-destination writer threads on TCP; instantaneous on the
+        # in-process fabrics) additionally keeps this thread itself
+        # from blocking toward an unreachable destination. Liveness
+        # frames carry no payload, so skipping the communicator's
+        # codec stage loses nothing.
+        zoo = self._zoo
+        if zoo.rank != controller_rank:
+            msg = Message(src=zoo.rank, dst=controller_rank,
+                          msg_type=MsgType.Control_Heartbeat)
+            try:
+                zoo.net.send_async(msg)
+            except Exception as exc:  # noqa: BLE001 - an unreachable
+                # controller reads as silence; the timeout check below
+                # decides when that becomes fatal.
+                log.debug("rank %d: heartbeat send failed: %s",
+                          zoo.rank, exc)
+            if zoo.controller_silent_for() > self._timeout:
+                zoo.peer_lost(controller_rank,
+                              f"controller silent for more than "
+                              f"{self._timeout}s")
+            return
+        # Controller rank: no self-heartbeat needed (silent_ranks skips
+        # its own rank); scan for newly silent ranks and fan the death
+        # notices to the survivors, per-destination so one unreachable
+        # survivor cannot stop the rest from hearing.
+        controller = zoo._actors.get(actors.CONTROLLER)
+        if controller is None:
+            return
+        for dead in controller.silent_ranks(self._timeout):
+            log.error("controller: rank %d silent for %.1fs — "
+                      "declaring it dead", dead, self._timeout)
+            for dst in range(zoo.net_size):
+                if dst == dead:
+                    continue
+                if dst == zoo.rank:
+                    # The controller is a survivor too: apply locally
+                    # (same path its communicator would have routed a
+                    # self-addressed notice through).
+                    zoo.peer_lost(dead, "declared dead by the "
+                                        "controller's liveness monitor")
+                    continue
+                notice = Message(src=zoo.rank, dst=dst,
+                                 msg_type=MsgType.Control_Dead_Peer)
+                notice.push(Blob(np.array([dead], dtype=np.int32)))
+                try:
+                    zoo.net.send_async(notice)
+                except Exception as exc:  # noqa: BLE001
+                    log.debug("rank %d: Dead_Peer notice to rank %d "
+                              "failed: %s", zoo.rank, dst, exc)
+        if controller.expired_dead_ranks(float(get_flag("rejoin_grace_s"))):
+            # A dead rank overstayed its rejoin grace: nudge the
+            # controller ACTOR to fail any parked barrier round (the
+            # round's state belongs to the actor thread; receive() is
+            # a thread-safe mailbox push).
+            controller.receive(Message(
+                src=zoo.rank, dst=zoo.rank,
+                msg_type=MsgType.Control_Check_Barriers))

@@ -32,8 +32,25 @@ from ..util.lock_witness import named_condition, named_lock
 from ..util.mt_queue import MtQueue
 
 
+class PeerLostError(RuntimeError):
+    """A peer endpoint died while the mesh was supposed to be up: a
+    writer thread hit a broken connection, a reader saw a dirty close,
+    or the controller's liveness monitor declared the rank dead.
+    Raised to senders blocked on that peer (instead of leaving them
+    enqueueing into a dead connection) and to table ``wait`` calls whose
+    request was in flight toward it. RETRYABLE: with ``-rpc_retry_max``
+    set, sync table calls back off and re-issue — a restarted peer that
+    rejoins then serves the retry."""
+
+
 class NetInterface:
-    """Abstract transport (ref: include/multiverso/net.h:15-49)."""
+    """Abstract transport (ref: include/multiverso/net.h:15-49).
+
+    Transports that can detect peer death (tcp.py) expose an
+    ``on_peer_lost`` callback attribute: called with the dead peer's
+    rank when known, or ``None`` when a connection died before
+    identifying itself. The Zoo installs its failure handler there at
+    start."""
 
     #: True when every rank shares this OS process (messages pass by
     #: reference, so Blob payloads — including device arrays — arrive

@@ -20,24 +20,43 @@ from typing import Deque, List, Optional
 import numpy as np
 
 from ..core.blob import Blob
-from ..core.message import (Message, MsgType, mark_error, stamp_version,
-                            unpack_add_batch)
+from ..core.message import (PEER_LOST_MARK, Message, MsgType, mark_error,
+                            stamp_version, unpack_add_batch)
 from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from . import device_lock
+# Imported eagerly so the -snapshot_* flag definitions are registered
+# before Zoo.start parses the command line (a lazily-imported module's
+# flags would silently fail to parse).
+from . import snapshot as snapshot_mod
 from .actor import Actor
 
 define_double("backup_worker_ratio", 0.0,
-              "reserved: PERCENTAGE of workers treated as backups by the "
-              "sync server ('set 20 means 20%' — defined-but-unused in "
-              "the reference too, ref: src/server.cpp:21). Parsed as a "
-              "double so pre-existing fractional configs (-backup_worker_"
-              "ratio=0.2) keep parsing; readers should round to an int "
-              "percentage")
+              "straggler cutoff for the BSP sync server: this share of "
+              "workers ('set 20 means 20%'; fractional 0.2 accepted "
+              "too) are treated as BACKUPS — the global vector clock "
+              "advances once the fastest (1 - ratio) of workers have "
+              "ticked, so an epoch finishes despite a straggling or "
+              "dead worker (its late ticks still apply, they just no "
+              "longer gate anyone). 0 (default) = strict BSP, the "
+              "reference's semantics (where this flag existed but was "
+              "unused, ref: src/server.cpp:21)")
 
 _INF = float("inf")
+
+
+def backup_worker_count(num_workers: int) -> int:
+    """-backup_worker_ratio as a worker count: 'set 20 means 20%' (the
+    reference's convention) with fractional values (0.2) accepted too;
+    clamped so at least one worker always gates the clock."""
+    ratio = float(get_flag("backup_worker_ratio"))
+    if ratio >= 1.0:
+        ratio = ratio / 100.0
+    if ratio <= 0 or num_workers <= 1:
+        return 0
+    return min(int(ratio * num_workers), num_workers - 1)
 
 
 class Server(Actor):
@@ -59,8 +78,15 @@ class Server(Actor):
     _no_lock = contextlib.nullcontext()
 
     def _lock_for(self, table):
-        return self._table_lock if getattr(table, "needs_device_lock",
-                                           True) else self._no_lock
+        """Device-backed tables serialize on the process-wide device
+        lock; host-only tables take their OWN per-instance state lock —
+        cheap (uncontended except versus the snapshotter, since the
+        actor thread is the only writer) but required so the async
+        snapshotter's (state, version) capture cannot tear against a
+        concurrent host-side add."""
+        if getattr(table, "needs_device_lock", True):
+            return self._table_lock
+        return getattr(table, "_state_lock", self._no_lock)
 
     def __init__(self, zoo) -> None:
         super().__init__(actors.SERVER, zoo)
@@ -69,6 +95,30 @@ class Server(Actor):
         self.register_handler(MsgType.Request_Add, self._process_add)
         self.register_handler(MsgType.Request_BatchAdd,
                               self._process_batch_add)
+        # Fault tolerance: periodic async snapshots + rejoin restore
+        # (runtime/snapshot.py), enabled by -snapshot_dir.
+        self._snapshots = None
+        if str(get_flag("snapshot_dir", "")):
+            self._snapshots = snapshot_mod.SnapshotManager(
+                zoo, self._table_lock)
+        # Rejoin readiness gate: on a RESTARTED rank, surviving workers
+        # start retrying requests the moment the communicator is up —
+        # before the application has re-created (and restored) the
+        # tables. Registration runs inside the table base constructor,
+        # so a registered-but-unready table must NACK retryably, not
+        # serve a half-constructed shard.
+        self._gate_unready = bool(get_flag("rejoin"))
+        self._ready_ids: set = set()
+
+    def start(self) -> None:
+        super().start()
+        if self._snapshots is not None:
+            self._snapshots.start()
+
+    def stop(self) -> None:
+        if self._snapshots is not None:
+            self._snapshots.stop()
+        super().stop()
 
     @staticmethod
     def get_server(zoo) -> "Server":
@@ -81,7 +131,43 @@ class Server(Actor):
 
     def register_table(self, server_table) -> int:
         self._store.append(server_table)
-        return len(self._store) - 1
+        table_id = len(self._store) - 1
+        if not self._gate_unready:
+            self._ready_ids.add(table_id)
+        if self._snapshots is not None:
+            # Track for the periodic cut. Restore (rejoin) and the
+            # snapshot-readiness mark wait for table_ready —
+            # registration runs inside the base constructor, before
+            # the shard's storage exists.
+            self._snapshots.track(table_id, server_table)
+        return table_id
+
+    def table_ready(self, server_table) -> None:
+        """A server table finished construction (table factory hook):
+        on a rejoining rank, restore it from the latest snapshot before
+        it serves its first request; in all cases, open it to the
+        snapshotter and (under the rejoin gate) to requests."""
+        if self._snapshots is not None:
+            self._snapshots.restore_if_pending(server_table)
+        try:
+            table_id = self._store.index(server_table)
+        except ValueError:
+            return
+        self._ready_ids.add(table_id)
+
+    def _table(self, table_id: int):
+        """The registered-and-ready table, or a RETRYABLE error: on a
+        rejoining restarted rank, requests can land after the server
+        actor starts but before the application re-created (or
+        finished constructing) this table — the requester must back
+        off and re-issue, not treat it as a fatal table-logic
+        failure."""
+        if 0 <= table_id < len(self._store) \
+                and table_id in self._ready_ids:
+            return self._store[table_id]
+        raise RuntimeError(
+            f"{PEER_LOST_MARK} table {table_id} not (yet) registered "
+            f"on rank {self._zoo.rank} — rejoin in progress?")
 
     # ref: src/server.cpp:36-46
     def _process_get(self, msg: Message) -> None:
@@ -94,7 +180,14 @@ class Server(Actor):
             # actor loop only logs; without this, every server-side CHECK
             # degrades to silent garbage at the caller).
             try:
-                table = self._store[msg.table_id]
+                if not msg.data:
+                    # Sync-mode clock-tick shard (worker full-coverage
+                    # padding): no table logic, no payload — the empty
+                    # reply only counts down the requester's waiter
+                    # (on a SyncServer the wrapper already ticked the
+                    # vector clock).
+                    return
+                table = self._table(msg.table_id)
                 with self._lock_for(table):
                     reply.data = table.process_get(msg.data)
                     # Multi-zoo mode: the gather must finish before the
@@ -120,16 +213,25 @@ class Server(Actor):
         with monitor("SERVER_PROCESS_ADD"):
             reply = msg.create_reply_message()
             try:
-                table = self._store[msg.table_id]
+                if not msg.data:
+                    # Clock-tick shard: see _process_get. No version
+                    # bump — nothing was applied.
+                    return
+                table = self._table(msg.table_id)
                 with self._lock_for(table):
                     table.process_add(msg.data)
                     # Multi-zoo mode: the update program (new table
                     # state) must land before the lock releases.
                     device_lock.settle(getattr(table, "_data", None))
-                # One bump per APPLIED Add; the ack carries the post-add
-                # version so the adder can resolve its self-invalidated
-                # cache slots (read-your-writes).
-                table.version += 1
+                    # One bump per APPLIED Add; the ack carries the
+                    # post-add version so the adder can resolve its
+                    # self-invalidated cache slots (read-your-writes).
+                    # INSIDE _lock_for(table): the snapshotter's
+                    # capture acquires the same lock (device lock or
+                    # the table's state lock) around each state cut and
+                    # version read, so a restore can never restore
+                    # state ahead of (or behind) its recorded version.
+                    table.version += 1
                 stamp_version(reply, table.version)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
@@ -190,12 +292,14 @@ class Server(Actor):
                     return
                 for sub in subs:
                     try:
-                        table = self._store[sub.table_id]
+                        table = self._table(sub.table_id)
                         with self._lock_for(table):
                             table.process_add(sub.data)
                             device_lock.settle(
                                 getattr(table, "_data", None))
-                        table.version += 1
+                            # Inside the lock for snapshot consistency
+                            # (see _process_add).
+                            table.version += 1
                         record(sub.table_id, sub.msg_id, None,
                                table.version)
                     except Exception as exc:  # noqa: BLE001 - per-sub
@@ -222,11 +326,24 @@ class _VectorClock:
     ``update(i)`` ticks worker i's local clock and returns True exactly when
     the global clock catches up to the max local clock (all workers level).
     ``finish_train(i)`` retires worker i (clock -> +inf).
-    """
 
-    def __init__(self, n: int):
+    **Backup-worker straggler cutoff** (``num_backup`` > 0, from
+    ``-backup_worker_ratio``): the global clock follows the
+    ``num_backup``-th smallest local clock instead of the strict
+    minimum — i.e. the slowest ``num_backup`` workers no longer gate
+    anyone. Their late ticks still count (a straggler's Adds apply when
+    they arrive; a DEAD worker simply never contributes), the fast
+    workers just stop waiting for them. With ``num_backup == 0`` every
+    code path below is the reference's strict-BSP logic, unchanged."""
+
+    def __init__(self, n: int, num_backup: int = 0):
         self._local = [0.0] * n
         self.global_clock = 0.0
+        self._num_backup = min(max(int(num_backup), 0), max(n - 1, 0))
+
+    @property
+    def num_backup(self) -> int:
+        return self._num_backup
 
     def local_clock(self, i: int) -> float:
         return self._local[i]
@@ -235,18 +352,41 @@ class _VectorClock:
         finite = [v for v in self._local if v != _INF]
         return max([self.global_clock] + finite)
 
+    def _cutoff_min(self) -> float:
+        """The clock the global follows: the (num_backup+1)-th smallest
+        local clock — retired (+inf) workers sort fastest and never
+        hold anything back; the num_backup slowest are skipped."""
+        return sorted(self._local)[self._num_backup]
+
     def update(self, i: int) -> bool:
         self._local[i] += 1
-        if self.global_clock < min(self._local):
+        if self._num_backup == 0:
+            if self.global_clock < min(self._local):
+                self.global_clock += 1
+                if self.global_clock == self._max_finite():
+                    return True
+            return False
+        advanced = False
+        # A straggler's late tick can move the cutoff several steps at
+        # once (its clock stops being the skipped one); catch up fully.
+        target = min(self._cutoff_min(), self._max_finite())
+        while self.global_clock < target:
             self.global_clock += 1
-            if self.global_clock == self._max_finite():
-                return True
-        return False
+            advanced = True
+        return advanced and self.global_clock == self._max_finite()
 
     def finish_train(self, i: int) -> bool:
         self._local[i] = _INF
-        if self.global_clock < min(self._local):
-            self.global_clock = min(self._local)
+        if self._num_backup == 0:
+            if self.global_clock < min(self._local):
+                self.global_clock = min(self._local)
+                if self.global_clock == self._max_finite():
+                    return True
+            return False
+        target = self._cutoff_min()
+        if self.global_clock < target:
+            self.global_clock = min(target, max(self._max_finite(),
+                                                self.global_clock))
             if self.global_clock == self._max_finite():
                 return True
         return False
@@ -265,8 +405,16 @@ class SyncServer(Server):
         self.register_handler(MsgType.Server_Finish_Train,
                               self._process_finish_train)
         n = zoo.num_workers
-        self._get_clocks = _VectorClock(n)
-        self._add_clocks = _VectorClock(n)
+        # Straggler cutoff (-backup_worker_ratio): the slowest
+        # num_backup workers stop gating the clocks — an epoch
+        # finishes despite a straggling or dead worker; its late
+        # requests still serve/apply when they arrive.
+        self._num_backup = backup_worker_count(n)
+        if self._num_backup:
+            log.info("sync server: %d of %d workers treated as "
+                     "backups (straggler cutoff)", self._num_backup, n)
+        self._get_clocks = _VectorClock(n, self._num_backup)
+        self._add_clocks = _VectorClock(n, self._num_backup)
         self._num_waited_add = [0] * n
         self._add_cache: Deque[Message] = collections.deque()
         self._get_cache: Deque[Message] = collections.deque()
@@ -288,8 +436,13 @@ class SyncServer(Server):
             super()._process_add(msg)
         finally:
             if self._add_clocks.update(worker):
-                assert not self._add_cache
-                self._drain_get_cache()
+                # Strict BSP invariant: at add-level no add can be
+                # cached. With a straggler cutoff the skipped worker's
+                # requests may still sit cached at leveling — the
+                # tolerant alternating drain handles both caches.
+                if self._num_backup == 0:
+                    assert not self._add_cache
+                self._drain_caches(gets=True)
 
     def _process_batch_add(self, msg: Message) -> None:
         """Defense in depth: workers never coalesce in sync mode (the
@@ -312,43 +465,61 @@ class SyncServer(Server):
             super()._process_get(msg)
         finally:
             if self._get_clocks.update(worker):
-                self._drain_add_cache()
+                self._drain_caches(adds=True)
 
     # ref: src/server.cpp:190-213
     def _process_finish_train(self, msg: Message) -> None:
         worker = self._zoo.rank_to_worker_id(msg.src)
         if self._add_clocks.finish_train(worker):
-            assert not self._add_cache
-            self._drain_get_cache()
+            if self._num_backup == 0:
+                assert not self._add_cache
+            self._drain_caches(gets=True)
         if self._get_clocks.finish_train(worker):
-            assert not self._get_cache
-            self._drain_add_cache()
+            if self._num_backup == 0:
+                assert not self._get_cache
+            self._drain_caches(adds=True)
 
-    def _drain_get_cache(self) -> None:
-        while self._get_cache:
-            get_msg = self._get_cache.popleft()
-            worker = self._zoo.rank_to_worker_id(get_msg.src)
-            # A raising drained request already sent its error reply;
-            # swallow here (with the log line Server._process_* emitted
-            # via its raise path unavailable, log directly) so the rest
-            # of the cache still drains and the clocks stay level.
-            try:
-                Server._process_get(self, get_msg)
-            except Exception:  # noqa: BLE001
-                log.error("sync server: drained get failed "
-                          "(error reply sent)")
-            leveled = self._get_clocks.update(worker)
-            assert not leveled
-
-    def _drain_add_cache(self) -> None:
-        while self._add_cache:
-            add_msg = self._add_cache.popleft()
-            worker = self._zoo.rank_to_worker_id(add_msg.src)
-            try:
-                Server._process_add(self, add_msg)
-            except Exception:  # noqa: BLE001
-                log.error("sync server: drained add failed "
-                          "(error reply sent)")
-            leveled = self._add_clocks.update(worker)
-            assert not leveled
-            self._num_waited_add[worker] -= 1
+    def _drain_caches(self, gets: bool = False, adds: bool = False) -> None:
+        """Drain the requested cache(s); when a drained request levels
+        the OTHER clock (possible only under a straggler cutoff, where
+        a late tick can move the global clock several steps), alternate
+        into the other cache until both settle. Strict BSP keeps the
+        reference's single-pass behavior and its no-releveling
+        invariant."""
+        while gets or adds:
+            if gets:
+                gets = False
+                while self._get_cache:
+                    get_msg = self._get_cache.popleft()
+                    worker = self._zoo.rank_to_worker_id(get_msg.src)
+                    # A raising drained request already sent its error
+                    # reply; swallow here (with the log line
+                    # Server._process_* emitted via its raise path
+                    # unavailable, log directly) so the rest of the
+                    # cache still drains and the clocks stay level.
+                    try:
+                        Server._process_get(self, get_msg)
+                    except Exception:  # noqa: BLE001
+                        log.error("sync server: drained get failed "
+                                  "(error reply sent)")
+                    leveled = self._get_clocks.update(worker)
+                    if self._num_backup == 0:
+                        assert not leveled
+                    elif leveled:
+                        adds = True
+            elif adds:
+                adds = False
+                while self._add_cache:
+                    add_msg = self._add_cache.popleft()
+                    worker = self._zoo.rank_to_worker_id(add_msg.src)
+                    try:
+                        Server._process_add(self, add_msg)
+                    except Exception:  # noqa: BLE001
+                        log.error("sync server: drained add failed "
+                                  "(error reply sent)")
+                    leveled = self._add_clocks.update(worker)
+                    if self._num_backup == 0:
+                        assert not leveled
+                    elif leveled:
+                        gets = True
+                    self._num_waited_add[worker] -= 1

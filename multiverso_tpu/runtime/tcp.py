@@ -46,7 +46,7 @@ from ..util.lock_witness import (acquire_timeout, named_condition,
                                  named_lock)
 from ..util.mt_queue import MtQueue
 from ..util.net_util import local_addresses
-from .net import NetInterface
+from .net import NetInterface, PeerLostError
 
 define_string("machine_file", "", "path: one host[:port] per rank line")
 define_int("port", 55555, "default TCP port when a machine-file line has none")
@@ -56,6 +56,13 @@ define_int("send_queue_mb", 32,
            "(backpressure) once this many serialized bytes are in flight "
            "to one destination — the transport twin of the worker "
            "coalescer's 4MB flush cap")
+define_double("connect_timeout_s", 30.0,
+              "seconds to keep retrying an outbound connection to a "
+              "peer that is not (yet) listening — covers both bootstrap "
+              "races and, with the fault-tolerance retry path, the "
+              "restart window of a crashed peer (a send toward a dead "
+              "rank blocks in connect-retry until the replacement "
+              "process binds, then delivers)")
 define_double("net_pace_mbps", 0.0,
               "emulate a constrained wire: pace outbound frames to this "
               "many megabits/s. The sleep happens BEFORE each write "
@@ -70,7 +77,6 @@ _HDR = struct.Struct("<8i")
 _LEN = struct.Struct("<Q")
 _NBLOBS = struct.Struct("<I")
 
-_CONNECT_TIMEOUT = 30.0  # seconds to wait for a peer to come up
 _RECV_INTERRUPT = object()
 
 
@@ -166,9 +172,12 @@ class _PeerWriter:
                    and not self._closed):
                 self._cond.wait(timeout=1.0)
             if self.error is not None:
-                raise RuntimeError(
-                    f"async send to rank {self._dst} failed"
-                ) from self.error
+                # The endpoint is DEAD (the writer thread died on it):
+                # typed so callers can tell a lost peer — retryable
+                # after a rejoin — from a local programming error.
+                raise PeerLostError(
+                    f"send to rank {self._dst} failed: peer connection "
+                    f"is dead ({self.error})") from self.error
             if self._closed:
                 raise RuntimeError("TcpNet finalized")
             self._frames.append(frame)
@@ -188,9 +197,9 @@ class _PeerWriter:
                 self._cond.wait(timeout=1.0 if remaining is None
                                 else min(remaining, 1.0))
             if self.error is not None:
-                raise RuntimeError(
-                    f"async send to rank {self._dst} failed"
-                ) from self.error
+                raise PeerLostError(
+                    f"send to rank {self._dst} failed: peer connection "
+                    f"is dead ({self.error})") from self.error
 
     @property
     def queued_bytes(self) -> int:
@@ -202,7 +211,10 @@ class _PeerWriter:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        self._thread.join(timeout=timeout)
+        if self._thread is not threading.current_thread():
+            # The dying writer itself retires its endpoint through
+            # drop_connection — it cannot join itself.
+            self._thread.join(timeout=timeout)
 
     def _main(self) -> None:
         while True:
@@ -225,14 +237,21 @@ class _PeerWriter:
             except BaseException as exc:  # noqa: BLE001 - the writer
                 # has no caller to raise into; ANY death (OSError,
                 # MemoryError, ...) must park in self.error and wake
-                # waiters, or submit()/flush() would hang on a silently
-                # dead thread instead of failing loudly.
+                # waiters — submit()/flush() then raise PeerLostError
+                # instead of enqueueing into a dead thread.
                 with self._cond:
                     self.error = exc
                     self._frames.clear()
                     self._queued_bytes = 0
                     self._writing = False
                     self._cond.notify_all()
+                # Mark the ENDPOINT dead too (outside our lock): drop
+                # the broken cached socket so a later retry reconnects,
+                # and report the peer so the zoo can fail blocked
+                # waiters instead of letting them hang. Quiet during
+                # finalize — a teardown race is not a peer death.
+                if isinstance(exc, OSError) and not self._net._closed:
+                    self._net._peer_connection_died(self._dst, exc)
                 return
             with self._cond:
                 self._queued_bytes -= len(frame)
@@ -302,11 +321,19 @@ class TcpNet(NetInterface):
             writer.flush(timeout=60.0)
         with monitor("tcp_serialize"):
             frame = _serialize(msg)
-        with monitor("tcp_send"):
-            with self._out_locks[dst]:
-                sock = self._connect(dst)
-                self._pace(len(frame))
-                sock.sendall(frame)
+        try:
+            with monitor("tcp_send"):
+                with self._out_locks[dst]:
+                    sock = self._connect(dst)
+                    self._pace(len(frame))
+                    sock.sendall(frame)
+        except OSError as exc:
+            # Broken connection mid-send: drop the cached socket (a
+            # retry must reconnect, not re-use the corpse), report the
+            # peer, and surface a typed retryable error.
+            self._peer_connection_died(dst, exc)
+            raise PeerLostError(
+                f"send to rank {dst} failed: {exc}") from exc
         self._count_sent(len(frame))
         return len(frame)
 
@@ -345,6 +372,43 @@ class TcpNet(NetInterface):
                 if writer is None:
                     writer = self._writers[dst] = _PeerWriter(self, dst)
         return writer
+
+    # -- peer-death bookkeeping --
+    def drop_connection(self, dst: int) -> None:
+        """Forget the outbound connection state for ``dst``: close the
+        cached socket and retire a (possibly dead) writer thread. The
+        next send toward ``dst`` reconnects from scratch — the
+        fault-tolerance retry path calls this when a peer is declared
+        dead so a restarted replacement process is actually reachable
+        instead of every retry hitting the broken socket."""
+        with self._lifecycle:
+            sock = self._out.pop(dst, None)
+            writer = self._writers.pop(dst, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if writer is not None:
+            writer.close(timeout=0.5)
+
+    def _peer_connection_died(self, dst: int, exc: BaseException) -> None:
+        """A connection toward ``dst`` broke while the mesh is live:
+        drop it and report the peer (readers report via their own dirty
+        -close path; this covers the SEND side, where the rank is
+        known)."""
+        if self._closed:
+            return
+        log.error("TcpNet rank %d: connection to rank %d died: %s",
+                  self._rank, dst, exc)
+        self.drop_connection(dst)
+        hook = self.on_peer_lost
+        if hook is not None:
+            try:
+                hook(dst)
+            except Exception:  # noqa: BLE001 - failure handling must
+                # not take the transport down with it
+                pass
 
     def _count_sent(self, nbytes: int) -> None:
         with self._stats_lock:
@@ -444,7 +508,8 @@ class TcpNet(NetInterface):
         if sock is not None:
             return sock
         host, port = self._peers[dst]
-        deadline = time.monotonic() + _CONNECT_TIMEOUT
+        connect_timeout = float(get_flag("connect_timeout_s"))
+        deadline = time.monotonic() + connect_timeout
         delay = 0.02
         while True:
             if self._closed:
@@ -452,11 +517,15 @@ class TcpNet(NetInterface):
             try:
                 sock = socket.create_connection((host, port), timeout=10)
                 break
-            except OSError:
+            except OSError as exc:
                 if time.monotonic() >= deadline:
-                    raise RuntimeError(
+                    # Typed as a lost peer: unreachable-within-timeout is
+                    # exactly the retryable condition (bootstrap race or
+                    # a crashed rank whose replacement has not bound yet).
+                    raise PeerLostError(
                         f"rank {self._rank}: cannot reach rank {dst} "
-                        f"at {host}:{port} within {_CONNECT_TIMEOUT}s")
+                        f"at {host}:{port} within {connect_timeout}s"
+                    ) from exc
                 time.sleep(delay)
                 delay = min(delay * 2, 0.5)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -486,6 +555,7 @@ class TcpNet(NetInterface):
 
     def _reader_main(self, conn: socket.socket) -> None:
         clean = False
+        peer = None  # rank learned from the frames this conn carries
         try:
             while not self._closed:
                 head = _read_exact(conn, _LEN.size)
@@ -501,6 +571,12 @@ class TcpNet(NetInterface):
                     return
                 with monitor("tcp_deserialize"):
                     msg = _deserialize(body)
+                # Every inbound frame names its sender; remembering it
+                # lets a dirty close report WHICH peer died (the zoo's
+                # rejoin path fails only that rank's in-flight requests
+                # instead of aborting the whole cluster).
+                if 0 <= msg.src < self.size and msg.src != self._rank:
+                    peer = msg.src
                 self._inbox.push(msg)
             clean = True
         except OSError:
@@ -513,11 +589,15 @@ class TcpNet(NetInterface):
             if not clean and not self._closed:
                 # A peer hung up while the mesh is live: report it so the
                 # zoo can abort blocked waits (the reference has no such
-                # detection — a dead MPI rank hangs the cluster).
+                # detection — a dead MPI rank hangs the cluster). The
+                # send side toward that peer is stale too — drop it so
+                # retries reconnect rather than write into the corpse.
+                if peer is not None:
+                    self.drop_connection(peer)
                 hook = self.on_peer_lost
                 if hook is not None:
                     try:
-                        hook()
+                        hook(peer)
                     except Exception:  # noqa: BLE001 - abort must not die
                         pass
 

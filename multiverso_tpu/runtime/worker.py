@@ -25,9 +25,9 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..core.message import (Message, MsgType, pack_add_batch,
-                            reply_version, take_error)
-from ..util.configure import define_bool, get_flag
+from ..core.message import (PEER_LOST_MARK, Message, MsgType,
+                            pack_add_batch, reply_version, take_error)
+from ..util.configure import define_bool, define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from . import device_lock
@@ -37,6 +37,14 @@ from .server import Server
 define_bool("coalesce_adds", True,
             "batch pending Add shards to the same server into one wire "
             "message (async mode over a wire transport only)")
+define_double("rpc_timeout_s", 0.0,
+              "diagnostic timeout on table request waiters: a Get/Add "
+              "whose replies do not all arrive within this many seconds "
+              "raises RpcTimeoutError naming the table, msg_id and the "
+              "peer ranks still pending — instead of blocking forever "
+              "on a reply that a silently-failed peer will never send. "
+              "0 (default) = wait without bound (the reference's "
+              "behavior)")
 
 #: Flush a server's staged batch at these caps even while the mailbox is
 #: still busy — an unbounded batch would trade latency for no extra win.
@@ -63,6 +71,14 @@ class Worker(Actor):
                           and not get_flag("sync", False))
         self._pending: Dict[int, List[Message]] = {}  # dst rank -> shards
         self._pending_bytes: Dict[int, int] = {}
+        # In-flight shard requests per destination rank: (dst, table_id,
+        # msg_id) added when a shard is sent (or staged), removed when
+        # its reply lands. Written only on this actor's thread; read
+        # from requester threads for timeout diagnostics (GIL-atomic
+        # set ops; a torn read only costs diagnostic precision).
+        self._inflight: set = set()
+        self.register_handler(MsgType.Control_Dead_Peer,
+                              self._process_dead_peer)
 
     def register_table(self, worker_table) -> int:
         self._cache.append(worker_table)
@@ -138,9 +154,10 @@ class Worker(Actor):
                 # from this worker or its vector clock falls permanently
                 # behind and the gate caches every OTHER worker's
                 # requests forever. Send an empty shard to every server:
-                # its table logic fails (error reply — first recorded
-                # error wins at the caller) but the sync server's
-                # finally-tick keeps the clocks level.
+                # it takes the server's tick-only path (benign reply,
+                # no table logic) and the sync server's finally-tick
+                # keeps the clocks level; the caller still raises from
+                # the failure recorded here.
                 table.fail(msg.msg_id, f"partition failed: {exc}",
                            count=False)
                 table.reset(msg.msg_id, self._zoo.num_servers)
@@ -154,13 +171,31 @@ class Worker(Actor):
             else:
                 table.fail(msg.msg_id, f"partition failed: {exc}")
             raise
-        table.reset(msg.msg_id, len(partitions))
-        for server_id, blobs in partitions.items():
+        # BSP full coverage: the sync server counts ONE request per
+        # worker per step on its vector clocks, but a hash/range
+        # partition may touch only a subset of servers (a kv add to a
+        # single key reaches one shard). Every uncovered server gets an
+        # EMPTY clock-tick shard — no table logic runs (the server's
+        # tick-only path), the benign reply just counts down this
+        # waiter — so no server's clock falls permanently behind and
+        # gates the other workers' requests forever. The
+        # partition-failure path below has always ticked this way; this
+        # is its success-path twin.
+        num_servers = self._zoo.num_servers
+        pad_sync = (get_flag("sync", False)
+                    and len(partitions) < num_servers)
+        table.reset(msg.msg_id,
+                    num_servers if pad_sync else len(partitions))
+        targets = range(num_servers) if pad_sync else partitions.keys()
+        for server_id in targets:
             dst = self._zoo.server_rank(server_id)
             shard = Message(src=self._zoo.rank, dst=dst,
                             msg_type=msg_type,
                             table_id=msg.table_id, msg_id=msg.msg_id)
-            shard.data = list(blobs)
+            blobs = partitions.get(server_id)
+            if blobs is not None:
+                shard.data = list(blobs)
+            self._inflight.add((dst, msg.table_id, msg.msg_id))
             if (self._coalesce and msg_type == MsgType.Request_Add
                     and dst != self._zoo.rank):
                 self._stage_add(dst, shard)
@@ -199,9 +234,58 @@ class Worker(Actor):
         per server shard)."""
         return self._zoo.rank_to_server_id(msg.src)
 
+    def pending_peers(self, table_id: int, msg_id: int) -> List[int]:
+        """Destination ranks a request is still awaiting replies from
+        (timeout diagnostics; best-effort read from requester threads)."""
+        return sorted(d for d, t, m in list(self._inflight)
+                      if t == table_id and m == msg_id)
+
+    def forget_request(self, table_id: int, msg_id: int) -> None:
+        """Drop a timed-out (abandoned) request's in-flight entries so
+        they don't accumulate or pollute later diagnostics. Called from
+        the REQUESTER thread: per-element discard is GIL-atomic, and a
+        racing reply on the actor thread discards the same tuples
+        harmlessly."""
+        for key in [k for k in list(self._inflight)
+                    if k[1] == table_id and k[2] == msg_id]:
+            self._inflight.discard(key)
+
+    def _process_dead_peer(self, msg: Message) -> None:
+        """A peer rank died (zoo.peer_lost): every in-flight shard
+        request toward it will never be answered — fail each one NOW
+        with a retryable marker so blocked wait() calls raise
+        PeerLostError instead of hanging. Runs on the actor thread, so
+        it serializes with sends and replies: no notify can race the
+        sweep."""
+        dead = int(msg.data[0].as_array(np.int32)[0])
+        # Staged (coalesced, not yet sent) shards toward the dead rank
+        # would fail at send time anyway; fail them here in one place.
+        staged = self._pending.pop(dead, None) or []
+        self._pending_bytes.pop(dead, None)
+        for shard in staged:
+            self._inflight.discard((dead, shard.table_id, shard.msg_id))
+            table = self._cache[shard.table_id]
+            table.fail(shard.msg_id,
+                       f"{PEER_LOST_MARK} rank {dead} died with this Add "
+                       f"staged", count=False)
+            table.notify(shard.msg_id)
+        # list() copy: forget_request on a requester thread may discard
+        # concurrently, and bare set iteration would raise on a resize.
+        lost = [key for key in list(self._inflight) if key[0] == dead]
+        for key in lost:
+            self._inflight.discard(key)
+            _dst, table_id, msg_id = key
+            table = self._cache[table_id]
+            table.fail(msg_id,
+                       f"{PEER_LOST_MARK} rank {dead} died before "
+                       f"replying (table {table_id}, msg {msg_id})",
+                       count=False)
+            table.notify(msg_id)
+
     # ref: src/worker.cpp:78-84
     def _process_reply_get(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
+        self._inflight.discard((msg.src, msg.table_id, msg.msg_id))
         # Every shard reply — error or not — counts exactly one notify
         # (the finally), so the waiter completes only after ALL shards
         # report; wait() then raises on any recorded failure. Releasing
@@ -211,6 +295,10 @@ class Worker(Actor):
             error = take_error(msg)
             if error is not None:
                 table.fail(msg.msg_id, error, count=False)
+            elif not msg.data:
+                # Benign tick reply (sync-mode full-coverage padding):
+                # nothing to hand to the table — just count it down.
+                pass
             else:
                 # Reply context (origin server, version stamp, request
                 # id): lets the table attribute the payload to a shard
@@ -237,6 +325,7 @@ class Worker(Actor):
     # ref: src/worker.cpp:86-88
     def _process_reply_add(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
+        self._inflight.discard((msg.src, msg.table_id, msg.msg_id))
         # The piggybacked version bump must land BEFORE the notify: the
         # adder's completion callback reads the tracker to resolve its
         # self-invalidated cache slots (read-your-writes).
@@ -288,6 +377,7 @@ class Worker(Actor):
         for i in range(int(desc[0])):
             table_id, msg_id, failed, version = (
                 int(v) for v in desc[1 + 4 * i:5 + 4 * i])
+            self._inflight.discard((msg.src, table_id, msg_id))
             table = self._cache[table_id]
             # Per-sub version stamp, noted before the notify (the
             # adder's cache-resolution callback reads it).

@@ -17,28 +17,45 @@ ref: src/zoo.cpp:49).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.blob import Blob
-from ..core.message import Message, MsgType
+from ..core.message import Message, MsgType, take_error
 from ..core.node import Node, Role, is_server, is_worker, role_from_string
 from ..util import log
-from ..util.configure import (define_bool, define_string, get_flag,
-                              parse_cmd_flags)
+from ..util.configure import (define_bool, define_double, define_int,
+                              define_string, get_flag, parse_cmd_flags)
 from ..util.mt_queue import MtQueue
 from . import actor as actors
 from .communicator import Communicator
 from .controller import Controller
-from .net import LocalFabric, NetInterface
-from .server import Server
+from .net import LocalFabric, NetInterface, PeerLostError
+from .server import Server, backup_worker_count
 from .tcp import TcpNet, take_pending_net
 from .worker import Worker
 
 define_string("ps_role", "default", "none / worker / server / default(all)")
 define_bool("ma", False, "model-average mode: skip the parameter server")
 define_bool("sync", False, "BSP sync server")
+define_bool("rejoin", False,
+            "this process is a RESTARTED rank rejoining a live cluster: "
+            "registration takes the controller's solo-reply path, the "
+            "start barrier and table-creation barriers are skipped "
+            "(the survivors are long past them), and — with "
+            "-snapshot_dir set — server tables restore from the latest "
+            "manifest-consistent snapshot as they register")
+define_int("rpc_retry_max", 0,
+           "how many times a failed sync table Get/Add is re-issued "
+           "after a PeerLostError (bounded exponential backoff from "
+           "-rpc_backoff_ms). 0 (default) disables the retry path AND "
+           "the peer-loss containment that feeds it: a lost peer then "
+           "aborts the whole zoo, the pre-fault-tolerance behavior")
+define_double("rpc_backoff_ms", 50.0,
+              "initial backoff before a PeerLostError retry; doubles "
+              "per attempt, capped at 5s")
 
 CONTROLLER_RANK = 0
 
@@ -78,6 +95,11 @@ class Zoo:
         self._worker_table_count = 0
         self._server_table_count = 0
         self._server_tables: List = []  # owned for cleanup + checkpoint
+        # -- fault tolerance --
+        self._rejoining = False
+        self._dead_peers: set = set()
+        self._heartbeat = None  # HeartbeatMonitor when enabled
+        self._last_controller_reply = 0.0
 
     # -- lifecycle (ref: src/zoo.cpp:41-60) --
     def start(self, argv: Optional[List[str]] = None,
@@ -87,16 +109,26 @@ class Zoo:
         registry is process-global; virtual ranks with heterogeneous roles
         need a per-zoo override)."""
         remaining = parse_cmd_flags(argv)
+        self._rejoining = bool(get_flag("rejoin"))
         self._net = net if net is not None else self._resolve_net()
         if hasattr(self._net, "on_peer_lost"):
             # Failure detection (absent in the reference, SURVEY.md
-            # section 5.3): a TCP peer dying mid-run aborts this zoo so
-            # blocked barriers/registrations/table waits raise instead
-            # of hanging.
-            self._net.on_peer_lost = self.abort
+            # section 5.3): a TCP peer dying mid-run reports through
+            # peer_lost — with the retry path off that aborts this zoo
+            # so blocked barriers/registrations/table waits raise
+            # instead of hanging; with -rpc_retry_max set, only the
+            # dead rank's in-flight requests fail (retryably).
+            self._net.on_peer_lost = \
+                lambda rank=None: self.peer_lost(rank, "connection died")
         self._role_override = role
         if not get_flag("ma"):
             self._start_ps()
+            self._last_controller_reply = time.monotonic()
+            interval = float(get_flag("heartbeat_interval_s", 0.0))
+            if interval > 0:
+                from .controller import HeartbeatMonitor
+                self._heartbeat = HeartbeatMonitor(self)
+                self._heartbeat.start()
         self._started = True
         log.debug("Rank %d: multiverso started", self.rank)
         return remaining
@@ -105,6 +137,9 @@ class Zoo:
         """ref: src/zoo.cpp:52-60,104-114."""
         if not self._started:
             return
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         if not get_flag("ma"):
             self._stop_ps(finalize_net)
         if finalize_net:
@@ -141,7 +176,12 @@ class Zoo:
             Server.get_server(self).start()
         if is_worker(role):
             Worker(self).start()
-        self.barrier()
+        if not self._rejoining:
+            # A rejoining restarted rank must not enter the start
+            # barrier: the survivors passed it long ago, and a fresh
+            # Control_Barrier from one rank would poison the NEXT
+            # full-cluster barrier's count.
+            self.barrier()
 
     def _stop_ps(self, finalize_net: bool = True) -> None:
         # After an abort the graceful drain (finish_train + barrier) would
@@ -296,6 +336,83 @@ class Zoo:
         if worker is not None:
             worker.abort_tables(f"rank {self.rank}: cluster aborted")
 
+    # -- fault containment: a lost peer need not kill the zoo --
+    @property
+    def rejoining(self) -> bool:
+        """True while this zoo is a restarted rank rejoining a live
+        cluster (-rejoin): collective-creation barriers are skipped."""
+        return self._rejoining
+
+    def note_controller_alive(self) -> None:
+        """A heartbeat reply arrived (communicator routing)."""
+        self._last_controller_reply = time.monotonic()
+
+    def controller_silent_for(self) -> float:
+        return time.monotonic() - self._last_controller_reply
+
+    def peer_lost(self, rank: Optional[int], reason: str) -> None:
+        """A peer died (broken connection, or declared dead by the
+        controller's liveness monitor). With the retry path enabled
+        (-rpc_retry_max > 0) and the dead rank identified — and not the
+        controller, whose loss is unrecoverable — only that rank's
+        in-flight table requests fail, with a retryable PeerLostError;
+        everything else keeps serving so the rank can restart and
+        rejoin. Otherwise this degrades to ``abort()``: the
+        pre-fault-tolerance kill-the-zoo behavior.
+
+        BSP (``-sync``) narrows containment: the sync servers count
+        exactly one request per worker per step on their vector
+        clocks, so a lost SERVER cannot be papered over by re-issuing
+        requests (the surviving servers would double-count the step —
+        see ``retrying_wait``) and a lost WORKER permanently stalls
+        the clocks unless backup workers (-backup_worker_ratio) cover
+        its ticks. Only the covered-dead-worker case stays contained
+        in sync mode; everything else aborts."""
+        if rank == self.rank or self._aborted:
+            return
+        if rank is not None and rank in self._dead_peers:
+            # Already swept (a TCP writer death and the controller's
+            # monitor often both report the same corpse); re-running
+            # would drop_connection a REPLACEMENT's fresh socket if the
+            # rank already rejoined between the two reports.
+            return
+        retryable = (int(get_flag("rpc_retry_max")) > 0
+                     and rank is not None and rank != CONTROLLER_RANK)
+        if retryable and get_flag("sync", False):
+            node = self._nodes[rank] if rank < len(self._nodes) else None
+            retryable = (node is not None
+                         and not is_server(node.role)
+                         and backup_worker_count(self._num_workers) > 0)
+        if not retryable:
+            log.error("Rank %d: peer %s lost (%s) — aborting this zoo",
+                      self.rank, "?" if rank is None else rank, reason)
+            self.abort()
+            return
+        log.error("Rank %d: peer %d lost (%s) — failing its in-flight "
+                  "requests, cluster keeps serving", self.rank, rank,
+                  reason)
+        self._dead_peers.add(rank)
+        if hasattr(self._net, "drop_connection"):
+            # Stale outbound state toward the dead peer must go: a
+            # restarted process on the same endpoint is a NEW socket.
+            self._net.drop_connection(rank)
+        worker = self._actors.get(actors.WORKER)
+        if worker is not None:
+            notice = Message(src=self.rank, dst=self.rank,
+                             msg_type=MsgType.Control_Dead_Peer)
+            notice.push(Blob(np.array([rank], dtype=np.int32)))
+            worker.receive(notice)
+
+    def notice_peer_alive(self, rank: int) -> None:
+        """Inbound traffic from a previously-declared-dead rank: its
+        restarted process is talking again — clear the death mark so a
+        SECOND death of the same rank sweeps again instead of being
+        swallowed by peer_lost's idempotency guard."""
+        if rank in self._dead_peers:
+            self._dead_peers.discard(rank)
+            log.info("Rank %d: peer %d is back (traffic resumed)",
+                     self.rank, rank)
+
     def _pop_control(self):
         reply = self.mailbox.pop()
         if reply is _ABORT or self._aborted:
@@ -309,6 +426,13 @@ class Zoo:
         self.send_to(actors.COMMUNICATOR, msg)
         reply = self._pop_control()
         assert reply is not None and reply.type == MsgType.Control_Reply_Barrier
+        error = take_error(reply)
+        if error is not None:
+            # The controller failed the round: a declared-dead rank
+            # stayed gone past -rejoin_grace_s, so the barrier could
+            # never have completed. Retryable — a later rejoin lets
+            # the next barrier() succeed.
+            raise PeerLostError(error)
 
     def finish_train(self) -> None:
         """Retire this rank's worker from the BSP clocks on all servers."""
@@ -336,6 +460,13 @@ class Zoo:
         self._server_tables.append(server_table)
         self._server_table_count = tid + 1
         return tid
+
+    def server_table_ready(self, server_table) -> None:
+        """Table-factory hook: the server table is fully constructed —
+        a rejoining rank restores it from the latest snapshot now."""
+        server = self._actors.get(actors.SERVER)
+        if server is not None:
+            server.table_ready(server_table)
 
     @property
     def server_tables(self) -> List:

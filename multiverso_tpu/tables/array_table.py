@@ -69,11 +69,12 @@ class ArrayWorker(WorkerTable):
         if bound > 0:
             self._blob_cache = BlobCache(bound, self._num_server,
                                          self._version_tracker)
+            self._caches.append(self._blob_cache)
         self._pf_id: Optional[int] = None  # in-flight whole-table prefetch
 
     # -- public API (ref: array_table.cpp:29-66) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
-        self.wait(self.get_async(out))
+        self.retrying_wait(lambda: self.get_async(out))
         return self._dest
 
     def get_async(self, out: Optional[np.ndarray] = None) -> int:
@@ -116,7 +117,7 @@ class ArrayWorker(WorkerTable):
 
     def add(self, delta: np.ndarray,
             option: Optional[AddOption] = None) -> None:
-        self.wait(self.add_async(delta, option))
+        self.retrying_wait(lambda: self.add_async(delta, option))
 
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
         """Accepts host or device arrays; a device delta rides the whole
@@ -253,6 +254,21 @@ class ArrayServer(ServerTable):
     # -- checkpoint (ref: array_table.cpp:143-151) --
     def store(self, stream) -> None:
         stream.write(np.asarray(self._values()).tobytes())
+
+    # -- async snapshot split (runtime/snapshot.py) --
+    def snapshot_state(self):
+        """Consistent capture under the caller's table lock: a jitted
+        copy into a FRESH device buffer. Holding the live ``self._data``
+        reference is NOT enough — the updater donates it away on the
+        next add (``donate_argnums``), deleting the captured buffer
+        under the snapshotter's feet. The copy stays on device; the
+        host transfer + serialization run off the lock in
+        ``write_snapshot``."""
+        return device_lock.settle(self._snapshot(self._data))
+
+    def write_snapshot(self, state, stream) -> None:
+        """Off-lock serialization of a captured shard (store-format)."""
+        stream.write(np.asarray(state).tobytes())
 
     def load(self, stream) -> None:
         raw = stream.read(self.size * self.dtype.itemsize)

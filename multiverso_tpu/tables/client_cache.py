@@ -135,6 +135,21 @@ class VersionTracker:
     def latest(self, server_id: int) -> int:
         return self._latest.get(server_id, -1)
 
+    def regressed(self, server_id: int, version: int) -> bool:
+        """True when a stamped reply carries a LOWER version than the
+        latest observed from that shard. Versions per shard only ever
+        grow within one server generation (monotonic counter, FIFO
+        reply stream), so a regression means the server RESTARTED and
+        reset/restored its counter — the generation-change signal the
+        caches invalidate on (docs/CLIENT_CACHE.md)."""
+        return 0 <= version < self.latest(server_id)
+
+    def reset(self, server_id: int, version: int) -> None:
+        """Re-anchor a shard's latest-observed version downward after a
+        server generation change (``note`` only moves it up)."""
+        with self._lock:
+            self._latest[server_id] = version
+
     def known_servers(self) -> List[int]:
         with self._lock:
             return list(self._latest)
@@ -313,6 +328,23 @@ class RowCache:
                 self._floor[r] = max(self._floor.get(r, -1),
                                      self._tracker.latest(int(s)))
 
+    def invalidate_server(self, server_id: int) -> None:
+        """Drop every row owned by a shard whose server changed
+        generation (restart + snapshot restore): entries and floors
+        recorded against the old generation's version counter are
+        meaningless against the restored one."""
+        sid = int(server_id)
+        with self._lock:
+            touched = set(self._rows) | set(self._floor)
+            if touched:
+                rows = np.asarray(sorted(touched), dtype=np.int64)
+                sids = self._server_of(rows)
+                for r, s in zip(rows, sids):
+                    if int(s) == sid:
+                        self._rows.pop(int(r), None)
+                        self._floor.pop(int(r), None)
+            self._floor_all.pop(sid, None)
+
     @property
     def stats(self) -> Dict[str, float]:
         total = self.hits + self.misses
@@ -416,6 +448,13 @@ class BlobCache:
                     self._floor[sid] = max(self._floor.get(sid, -1),
                                            self._tracker.latest(sid))
 
+    def invalidate_server(self, server_id: int) -> None:
+        """Server generation change: the shard's entry and floor are
+        stamped against a counter that no longer exists."""
+        with self._lock:
+            self._shards.pop(int(server_id), None)
+            self._floor.pop(int(server_id), None)
+
 
 class SnapshotCache:
     """Request-granular snapshot cache for KV worker tables: keyed by
@@ -484,3 +523,11 @@ class SnapshotCache:
                 for sid in self._tracker.known_servers():
                     self._floor[sid] = max(self._floor.get(sid, -1),
                                            self._tracker.latest(sid))
+
+    def invalidate_server(self, server_id: int) -> None:
+        """Server generation change: snapshots record multi-shard
+        version vectors, so any entry touching the restarted shard is
+        stale — clearing all is the simple safe sweep (rare event)."""
+        with self._lock:
+            self._entries.clear()
+            self._floor.pop(int(server_id), None)

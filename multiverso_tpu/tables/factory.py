@@ -54,10 +54,15 @@ def create_array_table(size: int, dtype=np.float32,
     role = _table_role(zoo)
     worker = None
     if is_server(role):
-        ArrayServer(size, dtype, zoo=zoo, updater_type=updater_type)
+        zoo.server_table_ready(
+            ArrayServer(size, dtype, zoo=zoo, updater_type=updater_type))
     if is_worker(role):
         worker = ArrayWorker(size, dtype, zoo=zoo)
-    zoo.barrier()
+    if not zoo.rejoining:
+        # A restarted rank rejoining a live cluster re-creates its
+        # tables alone — the survivors' creation barriers are long
+        # past, so entering one would poison the next real barrier.
+        zoo.barrier()
     return worker
 
 
@@ -70,16 +75,18 @@ def create_matrix_table(num_row: int, num_col: int, dtype=np.float32,
     role = _table_role(zoo)
     worker = None
     if is_server(role):
-        MatrixServer(num_row, num_col, dtype, is_sparse=is_sparse,
-                     is_pipeline=is_pipeline, zoo=zoo,
-                     updater_type=updater_type, random_init=random_init,
-                     seed=seed)
+        zoo.server_table_ready(
+            MatrixServer(num_row, num_col, dtype, is_sparse=is_sparse,
+                         is_pipeline=is_pipeline, zoo=zoo,
+                         updater_type=updater_type,
+                         random_init=random_init, seed=seed))
     if is_worker(role):
         worker = MatrixWorker(num_row, num_col, dtype,
                               is_sparse=is_sparse,
                               is_pipeline=is_pipeline, zoo=zoo,
                               updater_type=updater_type)
-    zoo.barrier()
+    if not zoo.rejoining:  # see create_array_table
+        zoo.barrier()
     return worker
 
 
@@ -89,10 +96,11 @@ def create_kv_table(key_dtype=np.int64, val_dtype=np.float32,
     role = _table_role(zoo)
     worker = None
     if is_server(role):
-        KVServer(key_dtype, val_dtype, zoo=zoo)
+        zoo.server_table_ready(KVServer(key_dtype, val_dtype, zoo=zoo))
     if is_worker(role):
         worker = KVWorker(key_dtype, val_dtype, zoo=zoo)
-    zoo.barrier()
+    if not zoo.rejoining:  # see create_array_table
+        zoo.barrier()
     return worker
 
 

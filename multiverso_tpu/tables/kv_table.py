@@ -45,6 +45,7 @@ class KVWorker(WorkerTable):
         self._snap_cache: Optional[SnapshotCache] = None
         if bound > 0:
             self._snap_cache = SnapshotCache(bound, self._version_tracker)
+            self._caches.append(self._snap_cache)
         self._collect_versions: Optional[Dict[int, int]] = None
 
     def get(self, keys) -> Dict[int, float]:
@@ -59,7 +60,8 @@ class KVWorker(WorkerTable):
             # Collect per-shard version stamps as the replies land (the
             # worker actor's reply context carries them).
             self._collect_versions = {}
-        self.wait(self.get_async_raw(Blob(keys.view(np.uint8))))
+        self.retrying_wait(
+            lambda: self.get_async_raw(Blob(keys.view(np.uint8))))
         if self._snap_cache is not None:
             versions, self._collect_versions = self._collect_versions, None
             if versions is not None and \
@@ -70,7 +72,7 @@ class KVWorker(WorkerTable):
         return self.raw
 
     def add(self, keys, values) -> None:
-        self.wait(self.add_async(keys, values))
+        self.retrying_wait(lambda: self.add_async(keys, values))
 
     def add_async(self, keys, values) -> int:
         keys = np.ascontiguousarray(keys, dtype=self.key_dtype).reshape(-1)
@@ -142,6 +144,18 @@ class KVServer(ServerTable):
 
     def store(self, stream) -> None:
         payload = pickle.dumps(self._store)
+        stream.write(struct.pack("<Q", len(payload)))
+        stream.write(payload)
+
+    # -- async snapshot split (runtime/snapshot.py) --
+    def snapshot_state(self):
+        """Consistent capture: ``dict(d)`` copies at C level without
+        releasing the GIL, so it is atomic against the server actor's
+        concurrent adds (KV tables run without the device table lock)."""
+        return dict(self._store)
+
+    def write_snapshot(self, state, stream) -> None:
+        payload = pickle.dumps(state)
         stream.write(struct.pack("<Q", len(payload)))
         stream.write(payload)
 

@@ -259,6 +259,7 @@ class MatrixWorker(WorkerTable):
                 lambda rows: np.minimum(rows // self._row_length,
                                         self._num_server - 1),
                 self._num_server, self._version_tracker)
+            self._caches.append(self._row_cache)
         # In-flight prefetch registry (+ dedup/join): msg_id -> sorted
         # unique ids being fetched; _pf_by_key dedups identical
         # prefetches; _pf_joined holds Gets deferred onto an in-flight
@@ -284,7 +285,7 @@ class MatrixWorker(WorkerTable):
 
     # -- Get API (ref: matrix_table.cpp:58-105) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
-        self.wait(self.get_async(out))
+        self.retrying_wait(lambda: self.get_async(out))
         return self._dest
 
     def get_async(self, out: Optional[np.ndarray] = None) -> int:
@@ -301,7 +302,7 @@ class MatrixWorker(WorkerTable):
 
     def get_rows(self, row_ids, out: Optional[np.ndarray] = None
                  ) -> np.ndarray:
-        self.wait(self.get_rows_async(row_ids, out))
+        self.retrying_wait(lambda: self.get_rows_async(row_ids, out))
         return self._dest
 
     def get_rows_async(self, row_ids,
@@ -579,7 +580,7 @@ class MatrixWorker(WorkerTable):
 
     # -- Add API (ref: matrix_table.cpp:110-147) --
     def add(self, delta, option: Optional[AddOption] = None) -> None:
-        self.wait(self.add_async(delta, option))
+        self.retrying_wait(lambda: self.add_async(delta, option))
 
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
         """Whole-table add; device arrays stay on device end to end."""
@@ -609,7 +610,8 @@ class MatrixWorker(WorkerTable):
 
     def add_rows(self, row_ids, delta,
                  option: Optional[AddOption] = None) -> None:
-        self.wait(self.add_rows_async(row_ids, delta, option))
+        self.retrying_wait(
+            lambda: self.add_rows_async(row_ids, delta, option))
 
     def add_rows_async(self, row_ids, delta,
                        option: Optional[AddOption] = None) -> int:
@@ -1382,6 +1384,17 @@ class MatrixServer(ServerTable):
     # -- checkpoint (ref: matrix_table.cpp:456-464) --
     def store(self, stream) -> None:
         stream.write(np.asarray(self._values()).tobytes())
+
+    # -- async snapshot split (runtime/snapshot.py) --
+    def snapshot_state(self):
+        """Capture under the caller's table lock (see
+        ArrayServer.snapshot_state: the updater DONATES the live
+        storage away on the next add, so the capture must copy into a
+        fresh device buffer; host transfer happens off-lock)."""
+        return device_lock.settle(self._snapshot(self._data))
+
+    def write_snapshot(self, state, stream) -> None:
+        stream.write(np.asarray(state).tobytes())
 
     def load(self, stream) -> None:
         raw = stream.read(self.my_rows * self.num_col * self.dtype.itemsize)

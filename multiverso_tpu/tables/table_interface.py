@@ -19,15 +19,21 @@ state for checkpointing (ref: include/multiverso/table_interface.h:61-75).
 
 from __future__ import annotations
 
+import io
+import itertools
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..core.blob import Blob
-from ..core.message import Message, MsgType
+from ..core.message import PEER_LOST_MARK, Message, MsgType
 from ..runtime import actor as actors
+from ..runtime.net import PeerLostError
 from ..runtime.zoo import current_zoo
 from ..util import log
+from ..util.configure import get_flag
 from ..util.dashboard import monitor
+from ..util.lock_witness import named_lock
 from ..util.waiter import Waiter
 from .client_cache import VersionTracker
 
@@ -36,12 +42,24 @@ from .client_cache import VersionTracker
 #: requests that fail are otherwise a slow leak over a long run.
 _MAX_RETAINED_ERRORS = 128
 
+#: Per-instance serial for ServerTable state-lock names: the lock-order
+#: witness keys its graph by NAME, so instances must not share one
+#: (client_cache.py precedent).
+_state_lock_serial = itertools.count()
+
 
 class TableRequestError(RuntimeError):
     """A table request failed remotely (server-side table logic or
     worker-side partition); raised by ``wait`` in the REQUESTER's thread.
     The actor runtime can only log — this carries the failure to the code
     that can actually handle it."""
+
+
+class RpcTimeoutError(TableRequestError):
+    """A table request's replies did not all arrive within
+    ``-rpc_timeout_s``; the message names the peer ranks still pending,
+    the table and the msg_id (mirroring the allreduce engine's
+    ``-allreduce_timeout_s`` rich errors)."""
 
 
 class WorkerTable:
@@ -59,6 +77,10 @@ class WorkerTable:
         # process_reply_get (server id, version stamp, request id) so
         # subclasses can attribute replies without a signature change.
         self._version_tracker = VersionTracker()
+        #: Client caches registered by subclasses — invalidated when a
+        #: serving shard changes GENERATION (server restart + snapshot
+        #: restore resets its version counter; docs/FAULT_TOLERANCE.md).
+        self._caches: List = []
         self._on_complete: Dict[int, List[Callable]] = {}
         self._reply_server = -1
         self._reply_version = -1
@@ -67,12 +89,53 @@ class WorkerTable:
     # -- public sync API (ref: src/table.cpp:29-38) --
     def get_raw(self, keys: Blob, extra: Sequence[Blob] = ()) -> None:
         with monitor("WORKER_TABLE_SYNC_GET"):
-            self.wait(self.get_async_raw(keys, extra))
+            self.retrying_wait(lambda: self.get_async_raw(keys, extra))
 
     def add_raw(self, keys: Blob, values: Blob,
                 option_blob: Optional[Blob] = None) -> None:
         with monitor("WORKER_TABLE_SYNC_ADD"):
-            self.wait(self.add_async_raw(keys, values, option_blob))
+            self.retrying_wait(
+                lambda: self.add_async_raw(keys, values, option_blob))
+
+    def retrying_wait(self, issue: Callable[[], int]) -> None:
+        """Issue a request and wait; on a retryable PeerLostError
+        re-issue with bounded exponential backoff (``-rpc_retry_max`` /
+        ``-rpc_backoff_ms``). With retries disabled (the default) this
+        is exactly ``wait(issue())``.
+
+        Semantics are AT-LEAST-ONCE for Adds: the dead server may have
+        applied the original before crashing, or — multi-server — the
+        shards on surviving servers applied while the lost shard did
+        not, so a retry re-applies them. For the additive updates the
+        PS serves this is bounded noise, the same order as what async
+        staleness already admits; exactly-once callers must build
+        idempotency above this layer (docs/FAULT_TOLERANCE.md).
+
+        BSP (``-sync``) force-disables the re-issue: the sync servers
+        count exactly one request per worker per step on their vector
+        clocks, so a retried request double-ticks the surviving
+        servers' clocks and permanently skews this worker ahead (the
+        leveling invariant breaks and cached peers strand). Sync-mode
+        fault tolerance is backup workers for dead WORKERS and a loud
+        abort for dead servers (zoo.peer_lost)."""
+        retry_max = int(get_flag("rpc_retry_max", 0))
+        if retry_max and get_flag("sync", False):
+            retry_max = 0
+        backoff = max(float(get_flag("rpc_backoff_ms", 50.0)), 1.0) / 1e3
+        attempt = 0
+        while True:
+            try:
+                self.wait(issue())
+                return
+            except PeerLostError:
+                attempt += 1
+                if attempt > retry_max:
+                    raise
+                delay = min(backoff * (2 ** (attempt - 1)), 5.0)
+                log.error("table %d: request lost its peer; retry "
+                          "%d/%d in %.0f ms", self.table_id, attempt,
+                          retry_max, delay * 1e3)
+                time.sleep(delay)
 
     # -- async API (ref: src/table.cpp:41-82) --
     def get_async_raw(self, keys: Blob, extra: Sequence[Blob] = ()) -> int:
@@ -142,18 +205,56 @@ class WorkerTable:
         if waiter is None:
             self._raise_if_failed(msg_id)
             return True  # already completed
-        ok = waiter.wait(timeout=timeout)
+        # -rpc_timeout_s turns an unbounded wait into a DIAGNOSTIC one:
+        # an explicit caller timeout keeps the boolean contract, but a
+        # flag-sourced expiry raises, naming what never replied — the
+        # difference between "a knob the caller handles" and "a lost
+        # reply that would otherwise block this thread forever".
+        flag_timeout = None
+        if timeout is None:
+            configured = float(get_flag("rpc_timeout_s", 0.0))
+            if configured > 0:
+                flag_timeout = configured
+        ok = waiter.wait(timeout=timeout if timeout is not None
+                         else flag_timeout)
         self._check_aborted()
         if ok:
             with self._mutex:
                 self._waitings.pop(msg_id, None)
             self._raise_if_failed(msg_id)
+        elif flag_timeout is not None:
+            worker = self._zoo._actors.get(actors.WORKER)
+            has_pending = (worker is not None
+                           and hasattr(worker, "pending_peers"))
+            peers = worker.pending_peers(self.table_id, msg_id) \
+                if has_pending else []
+            pending = waiter.pending
+            # The request is ABANDONED: reap its waiter, recorded
+            # error, and the worker's in-flight entries, or repeated
+            # timeouts (the flag's target scenario is a peer that
+            # never replies) leak one of each per request and pollute
+            # later pending_peers diagnostics. A late straggler reply
+            # finding no waiter is a no-op in notify().
+            with self._mutex:
+                self._waitings.pop(msg_id, None)
+                self._errors.pop(msg_id, None)
+            if has_pending:
+                worker.forget_request(self.table_id, msg_id)
+            raise RpcTimeoutError(
+                f"table {self.table_id} request {msg_id}: "
+                f"{pending} shard replies still missing after "
+                f"{flag_timeout}s (peers pending: "
+                f"{peers if peers else 'unknown'})")
         return ok
 
     def _raise_if_failed(self, msg_id: int) -> None:
         with self._mutex:
             error = self._errors.pop(msg_id, None)
         if error is not None:
+            if PEER_LOST_MARK in error:
+                # Typed retryable failure: the serving rank died; a
+                # restarted replacement can serve a re-issue.
+                raise PeerLostError(error)
             raise TableRequestError(error)
 
     def _check_aborted(self) -> None:
@@ -258,7 +359,21 @@ class WorkerTable:
 
     # -- client-cache version plumbing (driven by the worker actor) --
     def note_version(self, server_id: int, version: int) -> None:
-        """Record a version stamp observed on a reply from a server."""
+        """Record a version stamp observed on a reply from a server.
+        A version REGRESSION (reply below the shard's latest observed)
+        means the server restarted and restored an older snapshot:
+        re-anchor the tracker and invalidate every registered cache for
+        that shard — entries stamped against the previous generation's
+        counter must not serve against the restored one."""
+        if self._version_tracker.regressed(server_id, version):
+            log.error("table %d: server shard %d version regressed "
+                      "(%d -> %d): server generation change, "
+                      "invalidating client caches for that shard",
+                      self.table_id, server_id,
+                      self._version_tracker.latest(server_id), version)
+            self._version_tracker.reset(server_id, version)
+            for cache in self._caches:
+                cache.invalidate_server(server_id)
         self._version_tracker.note(server_id, version)
 
     def _begin_reply(self, server_id: int, version: int,
@@ -306,6 +421,19 @@ class ServerTable:
         #: actor once per successfully applied Add and stamped on every
         #: reply (client-cache staleness tracking).
         self.version = 0
+        #: Guards this shard's (state, version) PAIR for host-only
+        #: tables (``needs_device_lock=False``): their adds bypass the
+        #: process-wide device lock (by design — KV control plane must
+        #: not serialize two in-process servers), so without a
+        #: per-table lock the async snapshotter could capture state N
+        #: paired with version N+1, and a restore would then claim a
+        #: version whose add it lacks — defeating the client caches'
+        #: regression-based generation guard. Device-backed tables
+        #: never contend on it (their adds hold the device lock the
+        #: snapshotter also takes); acquired per-instance, so sibling
+        #: shards stay concurrent.
+        self._state_lock = named_lock(
+            f"server_table[{next(_state_lock_serial)}].state")
 
     def process_add(self, blobs: List[Blob]) -> None:
         raise NotImplementedError
@@ -318,6 +446,29 @@ class ServerTable:
 
     def load(self, stream) -> None:
         raise NotImplementedError
+
+    # -- async snapshot split (runtime/snapshot.py) --
+    #
+    # The periodic snapshotter wants a CONSISTENT cut without holding
+    # the server's table lock for the whole serialize+write:
+    # ``snapshot_state`` runs under the lock and must be cheap (capture
+    # a reference to the immutable device array / copy a small dict);
+    # ``write_snapshot`` runs OFF the lock, possibly much later, and
+    # must produce bytes that ``load`` accepts (i.e. store()-format).
+
+    def snapshot_state(self):
+        """Capture this shard's state for snapshotting. Fallback:
+        serialize eagerly (correct for any table, but does the full
+        store under the caller's lock — subclasses override with an
+        O(1) capture)."""
+        buf = io.BytesIO()
+        self.store(buf)
+        return buf.getvalue()
+
+    def write_snapshot(self, state, stream) -> None:
+        """Serialize a ``snapshot_state`` capture into ``stream`` in
+        ``store``-compatible format."""
+        stream.write(state)
 
     @property
     def zoo(self):

@@ -40,6 +40,18 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     # -- server / worker actors --
     "backup_worker_ratio": 0.0,
     "coalesce_adds": True,
+    # -- fault tolerance (runtime/snapshot.py, runtime/controller.py,
+    #    runtime/zoo.py, runtime/worker.py, runtime/tcp.py) --
+    "snapshot_interval_s": 0.0,
+    "snapshot_dir": "",
+    "rejoin": False,
+    "rpc_retry_max": 0,
+    "rpc_backoff_ms": 50.0,
+    "rpc_timeout_s": 0.0,
+    "heartbeat_interval_s": 0.0,
+    "heartbeat_timeout_s": 5.0,
+    "rejoin_grace_s": 30.0,
+    "connect_timeout_s": 30.0,
     # -- allreduce engine (runtime/allreduce_engine.py) --
     "allreduce_algo": "auto",
     "allreduce_chunk_kb": 512,

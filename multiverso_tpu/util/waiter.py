@@ -52,6 +52,13 @@ class Waiter:
         with self._mutex:
             return self._num_wait <= 0
 
+    @property
+    def pending(self) -> int:
+        """Outstanding notifies (diagnostic: how many shard replies a
+        timed-out request was still missing)."""
+        with self._mutex:
+            return max(self._num_wait, 0)
+
     def reset(self, num_wait: int) -> None:
         with self._cond:
             self._num_wait = num_wait

@@ -23,6 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy chaos/bench tests, excluded from the tier-1 run "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_flags():
     from multiverso_tpu.util import configure

@@ -5,12 +5,16 @@ reference's Docker test list (ref: deploy/docker/Dockerfile:105-106) that
 was dropped from its snapshot.
 """
 
+import json
+import os
+
 import numpy as np
 import pytest
 
 import multiverso_tpu as mv
-from multiverso_tpu.io import (StreamFactory, TextReader, load_checkpoint,
-                               save_checkpoint)
+from multiverso_tpu.io import (CheckpointError, StreamFactory, TextReader,
+                               load_checkpoint, save_checkpoint,
+                               write_bytes_atomic)
 
 
 @pytest.fixture
@@ -96,6 +100,74 @@ class TestCheckpoint:
         np.testing.assert_array_equal(mat.get_rows(np.array([3], np.int32)),
                                       np.ones((1, 4), np.float32))
         assert kv.get([9])[9] == pytest.approx(4.5)
+
+    def test_atomic_write_leaves_no_temp_debris(self, tmp_path):
+        path = tmp_path / "nested" / "obj.bin"
+        write_bytes_atomic(str(path), b"payload", fsync=True)
+        assert path.read_bytes() == b"payload"
+        assert [p.name for p in path.parent.iterdir()] == ["obj.bin"]
+
+    def test_torn_table_file_rejected_before_any_restore(self, env,
+                                                         tmp_path):
+        """A truncated table payload (crash mid-write, pre-rename copy
+        of an older era, disk corruption) must fail load_checkpoint
+        LOUDLY before any table is touched — not restore garbage."""
+        prefix = str(tmp_path / "ckpt")
+        arr = mv.create_array_table(32)
+        arr.add(np.arange(32, dtype=np.float32))
+        assert save_checkpoint(prefix) == 1
+        table_file = tmp_path / "ckpt.table0.rank0"
+        table_file.write_bytes(table_file.read_bytes()[:-4])
+        arr.add(np.ones(32, np.float32))  # post-save state to preserve
+        with pytest.raises(CheckpointError, match="torn"):
+            load_checkpoint(prefix)
+        # Nothing was restored: the live table still has the later add.
+        assert arr.get()[1] == pytest.approx(2.0)
+
+    def test_torn_manifest_rejected(self, env, tmp_path):
+        prefix = str(tmp_path / "ckpt")
+        mv.create_array_table(8).add(np.ones(8, np.float32))
+        assert save_checkpoint(prefix) == 1
+        manifest = tmp_path / "ckpt.manifest.rank0.json"
+        manifest.write_bytes(manifest.read_bytes()[:-10])
+        with pytest.raises(CheckpointError, match="torn"):
+            load_checkpoint(prefix)
+
+    def test_partial_manifest_table_count_mismatch_rejected(self, env,
+                                                            tmp_path):
+        """A manifest covering fewer tables than the rank registered
+        (partial save, table-creation drift between save and load) must
+        refuse the mixed restore."""
+        prefix = str(tmp_path / "ckpt")
+        mv.create_array_table(8).add(np.ones(8, np.float32))
+        assert save_checkpoint(prefix) == 1
+        mv.create_kv_table()  # registered after the save
+        with pytest.raises(CheckpointError, match="covers 1 tables"):
+            load_checkpoint(prefix)
+
+    def test_incomplete_flag_rejected(self, env, tmp_path):
+        prefix = str(tmp_path / "ckpt")
+        mv.create_array_table(8)
+        assert save_checkpoint(prefix) == 1
+        manifest = tmp_path / "ckpt.manifest.rank0.json"
+        doc = json.loads(manifest.read_text())
+        doc["complete"] = False
+        manifest.write_text(json.dumps(doc))
+        with pytest.raises(CheckpointError, match="partial"):
+            load_checkpoint(prefix)
+
+    def test_legacy_checkpoint_without_manifest_still_loads(self, env,
+                                                            tmp_path):
+        """Pre-manifest checkpoints (just the table files) keep loading
+        through the legacy path."""
+        prefix = str(tmp_path / "ckpt")
+        arr = mv.create_array_table(16)
+        arr.add(np.full(16, 3.0, np.float32))
+        assert save_checkpoint(prefix) == 1
+        os.unlink(tmp_path / "ckpt.manifest.rank0.json")
+        arr.add(np.ones(16, np.float32))
+        assert load_checkpoint(prefix) == 1
+        assert arr.get()[0] == pytest.approx(3.0)
 
 
 class TestHttpStream:
