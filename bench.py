@@ -541,60 +541,243 @@ def run_ps_two_workers(prebuilt, blocks: int = 48) -> dict:
             "per_worker": [round(r[0] / r[1], 0) for r in results]}
 
 
-def run_ps_two_servers(prebuilt, blocks: int = 48) -> dict:
-    """A MEASURED 2-server number (VERDICT r3 #3): the grouped
-    device-key PS pipeline against TWO in-process servers — ids
-    broadcast, foreign rows masked on device, replies summed in the
-    step. On ONE chip each server still processes the full key set, so
-    that work serializes and the honest same-window ratio is ~0.7x; on
-    separate chips (the deployment the protocol is for, exercised by
-    dryrun_multichip) the per-server gathers parallelize."""
-    from multiverso_tpu.models.wordembedding import (PSDeviceCorpusTrainer,
-                                                     PSWord2Vec,
-                                                     Word2VecConfig)
-    from multiverso_tpu.runtime.cluster import LocalCluster
-    dictionary, tokenized = prebuilt
+_SHARD_CHILD = r"""
+import os, sys, time, json
+import faulthandler
+faulthandler.dump_traceback_later(240 + 60 * int(sys.argv[2]), exit=True)
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_compilation_cache_dir',
+                  os.path.join({repo!r}, '.jax_cache'))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 5)
+sys.path.insert(0, {repo!r})
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.runtime import actor as actors
+from multiverso_tpu.util.dashboard import Dashboard, samples
 
-    def body(rank):
-        import multiverso_tpu as mv
-        config = Word2VecConfig(embedding_size=DIM, window=5,
-                                negative=NEG, epochs=EPOCHS,
-                                batch_size=BATCH, sample=1e-3,
-                                use_ps=True, neg_block=NEG_BLOCK)
-        model = PSWord2Vec(config, dictionary)
-        if rank == 1:  # server-only rank: hosts the second shard
-            for _ in range(2):
-                mv.current_zoo().barrier()
-            return None
-        trainer = PSDeviceCorpusTrainer(model, tokenized, PS_CENTERS,
-                                        blocks_per_dispatch=PS_GROUP)
-        trainer.train_epoch(seed=99, max_steps=2 * PS_GROUP)  # warm
-        w0 = model.trained_words
-        t0 = time.perf_counter()
-        trainer.train_epoch(seed=0, max_steps=blocks)
-        return model.trained_words - w0, time.perf_counter() - t0
+rank = int(sys.argv[1]); n = int(sys.argv[2])
+n_servers = n - 1
+# Rank 0 is the controller + THE worker; every other rank hosts one
+# server shard, so each server owns its own (emulated) wire.
+role = 'worker' if rank == 0 else 'server'
+mv.init(['-machine_file=' + {mf!r}, '-rank=' + str(rank),
+         '-ps_role=' + role, '-net_pace_mbps={pace}',
+         '-replica_hot_rows={hot_rows}', '-replica_report_gets=16',
+         '-replica_min_gets={min_gets}', '-replica_sync_every={sync_every}',
+         '-replica_sync_rows=8'])
+ROWS, COLS = {rows}, {cols}
+# A POOL of tables, as in a real model (word2vec alone has input +
+# output embeddings): the measured loop round-robins async Gets across
+# the pool, so per-op fixed costs (partition, turnaround, scheduler
+# latency on this one-core box) pipeline behind the paced wire instead
+# of adding to every op's critical path — each table still honors the
+# one-Get-in-flight rule.
+POOL = {pool}
+tables = [mv.create_matrix_table(ROWS, COLS)  # creation barrier inside
+          for _ in range(POOL)]
+table = tables[0]
+rng = np.random.default_rng(1234 + rank)
 
-    cluster = LocalCluster(2, roles=["all", "server"])
-    cluster.timeout = 600.0
-    results = cluster.run(body)
-    words, elapsed = results[0]
-    wps = round(words / elapsed, 0)
-    # Same-window single-server reference: launch overhead swings with
-    # tunnel weather between phases, so the meaningful ratio compares
-    # back-to-back runs, not this phase against the earlier ps_train.
-    # In a 1-rank cluster ``body``'s server-only branch is unreachable,
-    # so the reference runs the IDENTICAL measured loop. A reference
-    # failure must not discard the already-measured 2-server number.
+
+def zipf_ids(k):
+    # Word2vec-shaped key stream: ids sorted by frequency, so the Zipf
+    # head is CLUSTERED at low ids — i.e. inside server 0's row range.
+    # That concentration is exactly what hot-shard replication exists
+    # to fix (docs/SHARDING.md).
+    return np.unique((rng.zipf({zipf_a}, k) - 1) % ROWS).astype(np.int32)
+
+
+if rank == 0:
+    table.add(rng.standard_normal((ROWS, COLS)).astype(np.float32))
+    mv.barrier()  # content line
+    # Bucket-size warm sweep: per-shard gather jits compile per padded
+    # bucket width — a first-seen width MID-WINDOW is a multi-hundred-ms
+    # compile stall charged to one unlucky get.
+    for k in (4, 8, 16, 24, 32, 48, 64, 96, 128, 192, 256):
+        for t in tables:
+            t.get_rows(np.linspace(0, ROWS - 1, k).astype(np.int32))
+    t_end = time.perf_counter() + {warm_s}
+    t_cap = time.perf_counter() + 4 * {warm_s}
+    expect_replica = n_servers > 1 and {hot_rows} > 0
+    while time.perf_counter() < t_end or (
+            expect_replica and time.perf_counter() < t_cap
+            and not (table._replica_router is not None
+                     and table._replica_router.active)):
+        # Warm jits AND drive hot-row promotion: the timed window
+        # must measure the steady replicated state, not the
+        # promotion ramp (the cap keeps a broken control plane
+        # from wedging the phase; the result will show rate=None).
+        for t in tables:
+            t.get_rows(zipf_ids({draws}))
+    mv.barrier()  # start line
+    lat = []
+    rows_got = ops = adds = 0
+    inflight = []  # (table, msg_id, n_rows, issued_at) oldest first
+    t0 = time.perf_counter()
+    t_end = t0 + {window_s}
+    slot = 0
+    while time.perf_counter() < t_end:
+        ids = zipf_ids({draws})
+        t = tables[slot % POOL]
+        slot += 1
+        inflight.append((t, t.get_rows_async(ids), ids.size,
+                         time.perf_counter()))
+        if len(inflight) < POOL:
+            continue
+        t, mid, n_rows, issued = inflight.pop(0)
+        t.wait(mid)
+        lat.append((time.perf_counter() - issued) * 1e3)
+        rows_got += n_rows
+        ops += 1
+        if ops % {add_every} == 0:  # write-through + RYW floors exercised
+            aid = zipf_ids({add_draws})
+            table.add_rows(aid,
+                           np.full((aid.size, COLS), 1e-3, np.float32))
+            adds += 1
+    for t, mid, n_rows, issued in inflight:
+        t.wait(mid)
+        rows_got += n_rows
+        ops += 1
+    elapsed = time.perf_counter() - t0
+    mv.barrier()  # exit line
+    worker = mv.current_zoo()._actors.get(actors.WORKER)
+    comm = mv.current_zoo()._actors.get(actors.COMMUNICATOR)
+    lat.sort()
+    pick = lambda p: round(lat[min(int(len(lat) * p / 100),
+                                   len(lat) - 1)], 3) if lat else None
+    out = {{'rank': rank, 'get_ops': ops, 'adds': adds,
+            'elapsed': round(elapsed, 3),
+            'rows_per_s': round(rows_got / elapsed, 1),
+            'get_p50_ms': pick(50), 'get_p99_ms': pick(99),
+            'reqs_by_dst': {{str(k): v for k, v
+                             in worker.request_counts().items()}},
+            'queue_depths': {{str(k): v for k, v
+                              in comm.queue_depths().items()}},
+            'dispatch_ms': {{str(d): samples('DISPATCH_MS[d{{}}]'
+                                             .format(d)).snapshot()
+                             for d in range(1, n)}},
+            'repairs': Dashboard.get('REPLICA_REPAIR').count,
+            'stale_groups': Dashboard.get('REPLICA_STALE').count}}
+else:
+    for _ in range(3):  # content / start / exit lines
+        mv.barrier()
+    out = {{'rank': rank,
+            'server_gets': Dashboard.get('SERVER_PROCESS_GET').count,
+            'replica_hit_rows': Dashboard.get('REPLICA_HIT').count,
+            'replica_miss_rows': Dashboard.get('REPLICA_MISS').count,
+            'replica_syncs': Dashboard.get('REPLICA_SYNC').count}}
+faulthandler.cancel_dump_traceback_later()
+print('SHARDRES', json.dumps(out), flush=True)
+mv.barrier()
+mv.shutdown()
+"""
+
+
+def _run_shard_point(tmp: str, n_servers: int, pace_mbps: float,
+                     hot_rows: int, rows: int, cols: int,
+                     zipf_a: float, draws: int, warm_s: float,
+                     window_s: float, min_gets: int = 2,
+                     sync_every: int = 8, add_every: int = 32,
+                     add_draws: int = 8, pool: int = 4) -> dict:
+    """One point of the N-server scale-out sweep: 1 worker + n_servers
+    server processes on a paced localhost TCP mesh."""
+    from multiverso_tpu.util.net_util import free_listen_port
+    n = n_servers + 1
+    mf = os.path.join(tmp, f"shard_mf_{n_servers}.txt")
+    with open(mf, "w") as f:
+        for p in [free_listen_port() for _ in range(n)]:
+            f.write(f"127.0.0.1:{p}\n")
+    code = _SHARD_CHILD.format(
+        repo=os.path.dirname(os.path.abspath(__file__)), mf=mf,
+        pace=pace_mbps, hot_rows=hot_rows, rows=rows, cols=cols,
+        zipf_a=zipf_a, draws=draws, warm_s=warm_s, window_s=window_s,
+        min_gets=min_gets, sync_every=sync_every, add_every=add_every,
+        add_draws=add_draws, pool=pool)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, str(rank), str(n)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for rank in range(n)]
+    results = []
     try:
-        single = LocalCluster(1)
-        single.timeout = 600.0
-        s_words, s_elapsed = single.run(body)[0]
-        s_wps = round(s_words / s_elapsed, 0)
-        ratio = round(wps / max(s_wps, 1), 3)
-    except Exception as exc:  # noqa: BLE001
-        s_wps, ratio = f"error: {str(exc)[:120]}", None
-    return {"wps": wps, "single_server_wps": s_wps,
-            "vs_single_same_window": ratio}
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            if p.returncode:
+                raise RuntimeError(f"shard child failed: {err[-300:]}")
+            for line in out.splitlines():
+                if line.startswith("SHARDRES "):
+                    results.append(json.loads(line[9:]))
+    finally:
+        for p in procs:  # a raise must not orphan sibling ranks
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    worker = next(r for r in results if r["rank"] == 0)
+    servers = sorted((r for r in results if r["rank"] != 0),
+                     key=lambda r: r["rank"])
+    hits = sum(s["replica_hit_rows"] for s in servers)
+    misses = sum(s["replica_miss_rows"] for s in servers)
+    return {
+        "n_servers": n_servers,
+        "rows_per_s": worker["rows_per_s"],
+        "get_p50_ms": worker["get_p50_ms"],
+        "get_p99_ms": worker["get_p99_ms"],
+        "get_ops": worker["get_ops"],
+        "reqs_by_dst": worker["reqs_by_dst"],
+        "dispatch_ms": worker["dispatch_ms"],
+        "queue_depths": worker["queue_depths"],
+        "repairs": worker["repairs"],
+        "stale_groups": worker["stale_groups"],
+        "per_server_gets": [s["server_gets"] for s in servers],
+        "replica_hit_rows": hits,
+        "replica_miss_rows": misses,
+        "replica_hit_rate": round(hits / (hits + misses), 3)
+        if hits + misses else None,
+        "replica_syncs": sum(s["replica_syncs"] for s in servers),
+    }
+
+
+def run_ps_two_servers(prebuilt=None, tmp: str = None,
+                       servers=(1, 2, 4)) -> dict:
+    """N-server scale-out sweep (ISSUE 7 tentpole proof): 1 worker
+    driving Zipf-skewed row Get/Add traffic against N in {1,2,4} server
+    processes over the paced TCP transport (-net_pace_mbps emulates one
+    DCN-speed link PER endpoint, so N servers = N independent wires —
+    the deployment the sharded design is for; this box's single core
+    cannot show device-side scaling). The old one-chip device-pipeline
+    comparison this phase replaces measured broadcast physics (each
+    server processed the full key set on ONE chip — 2 servers were 2x
+    the device work) and could never reach 1.0x; docs/SHARDING.md
+    records the analysis. The Zipf head is CLUSTERED in server 0's row
+    range, as in word2vec's frequency-sorted vocabulary: without
+    hot-shard replication the head's bytes all leave server 0's wire
+    and siblings idle; with it (-replica_hot_rows) the head stripes
+    across every server's wire. Reports per-server request counts,
+    per-destination dispatch p50/p99 + queue depths, and the replica
+    hit rate, so a future regression localizes itself from the bench
+    record alone."""
+    if tmp is None:
+        tmp = tempfile.mkdtemp(prefix="mv_shard_")
+    sweep = []
+    for n_servers in servers:
+        sweep.append(_run_shard_point(
+            tmp, n_servers, pace_mbps=8.0, hot_rows=256,
+            rows=4096, cols=512, zipf_a=1.6, draws=512,
+            warm_s=4.0, window_s=6.0, min_gets=3, sync_every=4,
+            add_every=64, pool=2))
+    by_n = {point["n_servers"]: point for point in sweep}
+    base = by_n.get(1, {}).get("rows_per_s")
+    ratios = {n: round(point["rows_per_s"] / base, 3)
+              for n, point in by_n.items()} if base else {}
+    monotonic = all(
+        by_n[a]["rows_per_s"] < by_n[b]["rows_per_s"]
+        for a, b in zip(sorted(by_n), sorted(by_n)[1:]))
+    return {"sweep": sweep,
+            "scaling_vs_one_server": ratios,
+            "monotonic_1_2_4": monotonic,
+            "vs_single_same_window": ratios.get(2),
+            "pace_mbps": 8.0, "replica_hot_rows": 256}
 
 
 _TCP_CHILD = r"""
@@ -1640,7 +1823,7 @@ _PHASE_EST = {
     "local_train": 100, "ps_train": 110,
     "quality_local": 190, "quality_ps": 180,
     "ps_hostbatch": 70, "hs_train": 60,
-    "ps_two_workers": 60, "ps_two_servers": 95,
+    "ps_two_workers": 60, "ps_two_servers": 150,
     "tcp_one_process": 65, "tcp_two_process": 110,
     "matrix_bandwidth": 60, "local_retime": 60,
     "wire_codec": 15, "client_cache": 45, "allreduce": 120,
@@ -1919,7 +2102,7 @@ def main() -> None:
     result.merge(tcp_cross_process=tcp)
 
     two_servers = result.run("ps_two_servers", run_ps_two_servers,
-                             prebuilt)
+                             prebuilt, tmp)
     if two_servers:
         result.merge(ps_two_servers=two_servers,
                      ps_two_servers_vs_single=two_servers.get(

@@ -51,6 +51,14 @@ python -m pytest tests/test_wire_codec.py tests/test_client_cache.py -x -q
 echo "== allreduce engine (ring / rhalving / lossy EF / async writer) =="
 python -m pytest tests/test_allreduce.py -x -q
 
+echo "== sharding subset (routing equivalence / hot-shard replication) =="
+# Multi-server invariants get their own named gate: 1-vs-N element-wise
+# routing equivalence across all table types (boundary/off-by-one row
+# splits included), the replica protocol's read-your-writes floor and
+# version watermark, sticky promotion, and demotion pruning
+# (tests/test_sharding.py; docs/SHARDING.md).
+python -m pytest tests/test_sharding.py -x -q
+
 echo "== fault-tolerance subset (snapshots / rejoin / backup workers) =="
 # Crash-survival invariants get their own named gate: async snapshot
 # consistency + restore, dead-peer containment and retry, the BSP

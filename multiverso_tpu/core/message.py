@@ -27,6 +27,12 @@ class MsgType(enum.IntEnum):
     # wire message (extension — the reference sends one message per
     # shard; value chosen inside the server-bound request band).
     Request_BatchAdd = 3
+    # Hot-shard read replication (extension, docs/SHARDING.md): an
+    # OWNER server pushes refreshed values + its shard version for
+    # promoted rows to a replica-holding server. Fire-and-forget —
+    # no requester waiter exists, so no reply type pairs with it
+    # (value inside the server-bound request band).
+    Request_ReplicaSync = 4
     Reply_Get = -1
     Reply_Add = -2
     Reply_BatchAdd = -3
@@ -49,8 +55,17 @@ class MsgType(enum.IntEnum):
     #: on the wire): re-check whether a declared-dead rank has
     #: overstayed -rejoin_grace_s and pending barriers must fail.
     Control_Check_Barriers = 36
+    # Hot-shard replication control plane (docs/SHARDING.md): servers
+    # report per-row Get rates to the controller (controller band,
+    # >32); the controller broadcasts the promoted-row map to every
+    # rank with a value below the worker band, intercepted BY NAME in
+    # the communicator's routing (like Control_Dead_Peer — it must not
+    # fall through to the Zoo mailbox where a blocked barrier would
+    # consume it).
+    Control_Replica_Report = 37
+    Control_Replica_Map = -37
 
-HEADER_SIZE = 8  # ints
+HEADER_SIZE = 9  # ints (8 in the reference; slot 8 added for replication)
 
 
 class Message:
@@ -201,11 +216,40 @@ WIRE_SLOTS: dict = {
     "ERROR_SLOT": 5,
     "CODEC_SLOT": 6,
     "VERSION_SLOT": 7,
+    "REPLICA_SLOT": 8,
 }
 
 assert ERROR_SLOT == WIRE_SLOTS["ERROR_SLOT"]
 assert CODEC_SLOT == WIRE_SLOTS["CODEC_SLOT"]
 assert VERSION_SLOT == WIRE_SLOTS["VERSION_SLOT"]
+
+
+# Header slot 8 marks a Get reply that carries REPLICA-SERVED rows
+# (hot-shard read replication, docs/SHARDING.md): the wire value is
+# n_replica_rows + 1 (0 = header default = no replica content, the only
+# value pre-replication builds ever send). A marked reply's LAST payload
+# blob is an int32 replica descriptor
+#   [n_groups, (owner_sid, floor_version+1, n_rows) * n_groups]
+# and the reply's key vector is ordered [owned rows..., group 0 rows...,
+# group n-1 rows...]: the serving server attributes each replica group
+# to the shard that OWNS the rows, with the group's version floor (the
+# oldest owner version among the served rows). Growing the header from
+# 8 to 9 ints is a declared WIRE BREAK for mixed-build clusters
+# (docs/WIRE_FORMAT.md).
+REPLICA_SLOT = 8
+
+assert REPLICA_SLOT == WIRE_SLOTS["REPLICA_SLOT"]
+
+
+def mark_replica_reply(reply: "Message", n_replica_rows: int) -> None:
+    reply.header[REPLICA_SLOT] = int(n_replica_rows) + 1
+
+
+def replica_row_count(msg: "Message") -> int:
+    """Replica-served rows a Get reply carries (0 = none / pre-replica
+    peer)."""
+    raw = int(msg.header[REPLICA_SLOT])
+    return raw - 1 if raw > 0 else 0
 
 
 def stamp_version(reply: "Message", version: int) -> None:

@@ -14,6 +14,7 @@ to the Zoo mailbox (ref: src/communicator.cpp:13-29,93-105).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -23,11 +24,132 @@ from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             is_controller_bound, is_server_bound,
                             is_wire_encoded, is_worker_bound, mark_error)
 from ..util import log
-from ..util.configure import get_flag
+from ..util.configure import define_bool, get_flag
+from ..util.dashboard import samples
+from ..util.lock_witness import named_condition, named_lock
+from ..util.mt_queue import MtQueue
 from ..util.wire_codec import (CAP_WIRE_CODEC, decode_message,
                                encode_message)
 from . import actor as actors
 from .actor import Actor
+
+define_bool("dispatch_queues", True,
+            "per-destination dispatch queues for server-bound traffic "
+            "over wire transports: each destination rank gets its own "
+            "encode+send thread, so one slow or hot server no longer "
+            "head-of-line-blocks requests to its siblings behind the "
+            "communicator's single outbound thread (docs/SHARDING.md). "
+            "Per-destination FIFO — add-before-get order per "
+            "connection — is preserved; in-process fabrics skip the "
+            "queues (send is a mailbox push, there is no line to block)")
+
+
+class _DispatchQueues:
+    """Per-destination outbound queues + threads (wire transports only).
+
+    The communicator actor's single thread serializes codec-encode and
+    socket writes ACROSS destinations: with several servers, backpressure
+    or a long frame toward one destination delays every other server's
+    traffic (the ISSUE-7 head-of-line block). Server-bound requests are
+    instead handed to a per-destination thread that does the encode and
+    the (possibly blocking) send for just that peer. Per-destination
+    FIFO is preserved — everything to one dst flows through one queue —
+    which is the only order the protocol relies on (add-before-get per
+    connection). Queue depth and dispatch latency are recorded per
+    destination (``DISPATCH_QUEUE_DEPTH[d*]`` / ``DISPATCH_MS[d*]``
+    sample reservoirs) so the bench can localize a hot server."""
+
+    def __init__(self, comm: "Communicator"):
+        self._comm = comm
+        self._queues: dict = {}
+        self._threads: list = []
+        self._lock = named_lock(  # lazy per-dst creation
+            f"communicator.dispatchq[r{comm._zoo.rank}]")
+        # Byte-bounded, like TcpNet's async writer queues one layer
+        # down: the old actor-thread blocking send WAS the backpressure
+        # for server-bound traffic, and an unbounded queue would let a
+        # caller looping fire-and-forget adds against one slow/paced
+        # peer buffer payload bytes without limit. submit() blocks the
+        # communicator actor while a destination is over budget —
+        # under overload only, which is exactly the old behavior.
+        self._cap_bytes = max(int(get_flag("send_queue_mb", 32)), 1) << 20
+        self._queued_bytes: dict = {}
+        self._drained = named_condition(
+            f"communicator.dispatchq[r{comm._zoo.rank}].drained",
+            self._lock)
+
+    @staticmethod
+    def _nbytes(msg: Message) -> int:
+        return sum(int(b.size) for b in msg.data) + 64
+
+    def submit(self, msg: Message) -> None:
+        dst = msg.dst
+        queue = self._queues.get(dst)
+        if queue is None:
+            with self._lock:
+                queue = self._queues.get(dst)
+                if queue is None:
+                    queue = MtQueue(
+                        f"dispatchq[r{self._comm._zoo.rank}->d{dst}]")
+                    thread = threading.Thread(
+                        target=self._main, args=(dst, queue), daemon=True,
+                        name=f"mv-dispatch-r{self._comm._zoo.rank}-d{dst}")
+                    self._queues[dst] = queue
+                    self._threads.append(thread)
+                    thread.start()
+        nbytes = self._nbytes(msg)
+        with self._drained:
+            # Block until the destination is under budget — the same
+            # backpressure the old blocking actor-thread send provided.
+            # NO cap-busting escape hatch: a paced wire legitimately
+            # takes minutes to drain a large frame (bytes / pace_mbps),
+            # so a timeout override would silently re-open the
+            # unbounded-buffering hole exactly when pacing makes it
+            # easiest to hit. The drainer thread cannot die with work
+            # queued (its send errors are caught and routed), so this
+            # wait always ends; the periodic log just makes a long
+            # stall observable.
+            while self._queued_bytes.get(dst, 0) > self._cap_bytes:
+                if not self._drained.wait(timeout=30.0):
+                    log.info("dispatch queue d%d: still over budget "
+                             "after 30s (%d bytes queued) — waiting "
+                             "for the paced wire to drain", dst,
+                             self._queued_bytes.get(dst, 0))
+            self._queued_bytes[dst] = \
+                self._queued_bytes.get(dst, 0) + nbytes
+        samples(f"DISPATCH_QUEUE_DEPTH[d{dst}]").add(queue.size())
+        queue.push((time.perf_counter(), nbytes, msg))
+
+    def _main(self, dst: int, queue: MtQueue) -> None:
+        lat = samples(f"DISPATCH_MS[d{dst}]")
+        while True:
+            item = queue.pop()
+            if item is None:
+                break
+            queued_at, nbytes, msg = item
+            try:
+                self._comm._encode_and_send(msg)
+            except Exception:  # noqa: BLE001 - _encode_and_send already
+                # routed the failure (synthesized error reply /
+                # peer_lost); the queue must keep draining for the
+                # other messages.
+                log.error("dispatch queue d%d: send failed", dst)
+            with self._drained:
+                self._queued_bytes[dst] = \
+                    self._queued_bytes.get(dst, 0) - nbytes
+                self._drained.notify_all()
+            lat.add((time.perf_counter() - queued_at) * 1e3)
+
+    def stop(self) -> None:
+        """Drain-exit: queued frames still flush (MtQueue.pop returns
+        buffered items after exit), then the threads finish."""
+        for queue in list(self._queues.values()):
+            queue.exit()
+        for thread in self._threads:
+            thread.join(timeout=30)
+
+    def depths(self) -> dict:
+        return {dst: q.size() for dst, q in self._queues.items()}
 
 
 class Communicator(Actor):
@@ -42,6 +164,12 @@ class Communicator(Actor):
         # toward peers that ADVERTISED it during registration.
         self._codec = (not self._net.in_process
                        and bool(get_flag("wire_codec")))
+        # Per-destination dispatch queues (wire transports only):
+        # server-bound requests to different destinations must not
+        # serialize behind each other on this actor's one thread.
+        self._queues = _DispatchQueues(self) \
+            if (not self._net.in_process
+                and bool(get_flag("dispatch_queues"))) else None
 
     def start(self) -> None:
         super().start()
@@ -58,6 +186,10 @@ class Communicator(Actor):
         # hangs forever in its final barrier. (LocalNet's direct in-process
         # delivery masks this; a real wire transport does not.)
         super().stop()
+        if self._queues is not None:
+            # The actor drain may have pushed frames into the queues;
+            # they must hit the wire before the transport closes.
+            self._queues.stop()
         if finalize_net:
             self._net.finalize()
         else:
@@ -66,6 +198,11 @@ class Communicator(Actor):
             self._recv_thread.join(timeout=30)
         self._net.release_recv_owner()
 
+    def queue_depths(self) -> dict:
+        """Live per-destination dispatch queue depths (bench/monitor
+        observability; empty when the queues are off)."""
+        return self._queues.depths() if self._queues is not None else {}
+
     # Outbound path: actor mailbox -> wire (or loop back locally); every
     # message type goes through the same route-or-send dispatch. The
     # codec filter stage runs here — per message, gated on the PEER's
@@ -73,40 +210,70 @@ class Communicator(Actor):
     # frames (mixed-version clusters stay correct, merely uncompressed).
     def _dispatch(self, msg: Message) -> None:
         if msg.dst != self._zoo.rank:
-            if self._net.in_process and self._net.size > 1 \
-                    and any(b.on_device for b in msg.data):
-                # Materialize device payloads BEFORE they cross into a
-                # sibling virtual rank (LocalFabric multi-rank = tests
-                # and single-host multi-rank runs only; real one-zoo-
-                # per-process deployments never take this branch). A
-                # sibling's jit consuming a still-in-flight foreign
-                # array can wedge XLA's CPU runtime on a small host:
-                # the consumer occupies the execution pool waiting for
-                # a producer that needs the pool to run (the cross-rank
-                # twin of the Server._table_lock deadlock, observed as
-                # a server gather parked forever on a worker-produced
-                # id array in test_ps_device_pipeline_two_workers).
-                import jax
-                for blob in msg.data:
-                    if blob.on_device:
-                        jax.block_until_ready(blob.data)
-            if self._codec and \
-                    self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
-                encode_message(msg)
-            try:
-                self._net.send(msg)
-            except Exception as exc:  # noqa: BLE001 - a dead peer must
-                # not strand the requester's waiter (the actor loop
-                # would only log): synthesize the error reply the peer
-                # can no longer send, so wait() raises a retryable
-                # PeerLostError instead of blocking forever.
-                self._on_send_failed(msg, exc)
+            if self._queues is not None \
+                    and is_server_bound(msg.type_int):
+                # Server-bound traffic rides the destination's own
+                # queue thread: encode + send for a slow peer must not
+                # block this thread's traffic to its siblings.
+                self._queues.submit(msg)
+                return
+            self._encode_and_send(msg)
         else:
             self._local_forward(msg)
+
+    def _encode_and_send(self, msg: Message) -> None:
+        """Outbound tail shared by the actor thread and the dispatch
+        queue threads: settle in-process device payloads, run the codec
+        filter for capable peers, send, and route any transport failure
+        into the synthesized-error path."""
+        if self._net.in_process and self._net.size > 1 \
+                and any(b.on_device for b in msg.data):
+            # Materialize device payloads BEFORE they cross into a
+            # sibling virtual rank (LocalFabric multi-rank = tests
+            # and single-host multi-rank runs only; real one-zoo-
+            # per-process deployments never take this branch). A
+            # sibling's jit consuming a still-in-flight foreign
+            # array can wedge XLA's CPU runtime on a small host:
+            # the consumer occupies the execution pool waiting for
+            # a producer that needs the pool to run (the cross-rank
+            # twin of the Server._table_lock deadlock, observed as
+            # a server gather parked forever on a worker-produced
+            # id array in test_ps_device_pipeline_two_workers).
+            import jax
+            for blob in msg.data:
+                if blob.on_device:
+                    jax.block_until_ready(blob.data)
+        if self._codec and \
+                self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
+            encode_message(msg)
+        try:
+            self._net.send(msg)
+        except Exception as exc:  # noqa: BLE001 - a dead peer must
+            # not strand the requester's waiter (the actor loop
+            # would only log): synthesize the error reply the peer
+            # can no longer send, so wait() raises a retryable
+            # PeerLostError instead of blocking forever.
+            self._on_send_failed(msg, exc)
 
     def _on_send_failed(self, msg: Message, exc: BaseException) -> None:
         log.error("rank %d: send of %r to rank %d failed: %s",
                   self._zoo.rank, msg, msg.dst, exc)
+        if msg.type_int == int(MsgType.Request_ReplicaSync):
+            # Best-effort fire-and-forget refresh: no waiter exists to
+            # strand, and a dead HOLDER must not escalate into aborting
+            # the owner. But the lost chunk's rows must be RE-DIRTIED at
+            # the owner — a later watermark-carrying flush would
+            # otherwise certify the holder's un-refreshed entries as
+            # current, and the worker's read-your-writes floor would
+            # accept pre-write values (the holder's sync-seq gap guard
+            # is the backstop; this echo is the proactive heal). A real
+            # inbound sync always carries the OWNER's src rank, so the
+            # server actor recognizes the echo by src == own rank.
+            if is_wire_encoded(msg):
+                decode_message(msg)
+            if self._zoo._actors.get(actors.SERVER) is not None:
+                self._zoo.route(actors.SERVER, msg)
+            return
         reason = f"{PEER_LOST_MARK} rank {msg.dst} unreachable: {exc}"
         reply = self._synth_error_reply(msg, reason)
         if reply is not None:
@@ -204,6 +371,20 @@ class Communicator(Actor):
                 else -1
             self._zoo.peer_lost(dead, "declared dead by the controller's "
                                       "liveness monitor")
+            return
+        if msg_type == int(MsgType.Control_Replica_Map):
+            # Promoted-row map broadcast: both sides of this rank need
+            # it — the worker's tables re-route their Gets, the
+            # server's tables start/stop the owner-side write-through
+            # fan-out and prune demoted replica entries. Forward a
+            # clone to each actor so each applies it on its own thread
+            # (payload blobs are shared read-only).
+            for name in (actors.WORKER, actors.SERVER):
+                if self._zoo._actors.get(name) is not None:
+                    copy = Message(src=msg.src, dst=msg.dst,
+                                   msg_type=MsgType.Control_Replica_Map)
+                    copy.data = list(msg.data)
+                    self._zoo.route(name, copy)
             return
         if is_server_bound(msg_type):
             try:

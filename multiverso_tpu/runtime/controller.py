@@ -39,6 +39,7 @@ from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             mark_error)
 from ..core.node import Node, is_server, is_worker
+from . import replica as replica_mod
 from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.lock_witness import named_condition, named_lock
@@ -90,6 +91,12 @@ class Controller(Actor):
                               self._process_heartbeat)
         self.register_handler(MsgType.Control_Check_Barriers,
                               self._process_check_barriers)
+        # Hot-shard replication: aggregate per-server hot-row reports
+        # into the promoted-row map and broadcast it on change
+        # (docs/SHARDING.md; runtime/replica.py has the policy).
+        self._replicas = replica_mod.ReplicaCoordinator()
+        self.register_handler(MsgType.Control_Replica_Report,
+                              self._process_replica_report)
 
     # -- liveness bookkeeping --
     def _note_alive(self, rank: int) -> None:
@@ -148,6 +155,33 @@ class Controller(Actor):
                 f"{expired} declared dead and absent past "
                 f"-rejoin_grace_s={grace}"))
             self.send_to(actors.COMMUNICATOR, reply)
+
+    def _process_replica_report(self, msg: Message) -> None:
+        """A server's hot-row window (table named by msg.table_id,
+        blob 0 = rows, blob 1 = counts). On a promoted-set change,
+        broadcast the full map to every rank — including this one, so
+        the local worker/server actors apply it through the same
+        routing path."""
+        self._note_alive(msg.src)
+        if not msg.data or len(msg.data) < 2:
+            return
+        rows = msg.data[0].as_array(np.int32)
+        counts = msg.data[1].as_array(np.int32)
+        if not self._replicas.ingest(msg.table_id, rows, counts,
+                                     reporter=msg.src):
+            return
+        blobs = replica_mod.pack_replica_map(self._replicas.epoch,
+                                             self._replicas.promoted)
+        log.info("controller: replica map epoch %d (%s)",
+                 self._replicas.epoch,
+                 {t: int(r.size)
+                  for t, r in self._replicas.promoted.items()})
+        for dst in range(self._zoo.net_size):
+            notice = Message(src=self._zoo.rank, dst=dst,
+                             msg_type=MsgType.Control_Replica_Map)
+            for arr in blobs:
+                notice.push(Blob(arr))
+            self.send_to(actors.COMMUNICATOR, notice)
 
     def _process_heartbeat(self, msg: Message) -> None:
         self._note_alive(msg.src)

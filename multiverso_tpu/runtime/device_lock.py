@@ -40,15 +40,43 @@ _serialized = 0  # nesting count of active multi-zoo contexts
 _state_lock = named_lock("device_lock.state")
 
 
+def _single_device() -> bool:
+    """The wedge class this lock exists for is CONCURRENT MULTI-DEVICE
+    programs: each such program partially occupies XLA's shared CPU
+    execution pool waiting on inter-device rendezvous, and two in
+    flight can each hold resources the other needs. A process whose
+    platform exposes exactly ONE device never builds those programs —
+    its dispatches are ordinary single-device executions, which JAX
+    supports from concurrent threads — so serializing (and settling,
+    which kills async pipelining) would only cost throughput. Tests run
+    under the 8-virtual-device conftest mesh and therefore KEEP the
+    lock; a plain CPU/one-chip bench process drops it. Computed lazily
+    (jax import cost) and cached: the device count never changes
+    mid-process."""
+    global _single_device_cached
+    if _single_device_cached is None:
+        import jax
+        _single_device_cached = len(jax.devices()) == 1
+    return _single_device_cached
+
+
+_single_device_cached = None
+
+
 def enable() -> None:
-    """Enter multi-zoo mode: serialize + settle all device dispatch."""
+    """Enter multi-zoo mode: serialize + settle all device dispatch
+    (no-op on single-device processes — see ``_single_device``)."""
     global _serialized
+    if _single_device():
+        return
     with _state_lock:
         _serialized += 1
 
 
 def disable() -> None:
     global _serialized
+    if _single_device():
+        return
     with _state_lock:
         _serialized -= 1
 

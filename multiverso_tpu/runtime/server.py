@@ -21,12 +21,14 @@ import numpy as np
 
 from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType, mark_error,
-                            stamp_version, unpack_add_batch)
+                            mark_replica_reply, stamp_version,
+                            unpack_add_batch)
 from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
 from . import device_lock
+from . import replica as replica_mod
 # Imported eagerly so the -snapshot_* flag definitions are registered
 # before Zoo.start parses the command line (a lazily-imported module's
 # flags would silently fail to parse).
@@ -79,12 +81,20 @@ class Server(Actor):
 
     def _lock_for(self, table):
         """Device-backed tables serialize on the process-wide device
-        lock; host-only tables take their OWN per-instance state lock —
-        cheap (uncontended except versus the snapshotter, since the
-        actor thread is the only writer) but required so the async
-        snapshotter's (state, version) capture cannot tear against a
-        concurrent host-side add."""
-        if getattr(table, "needs_device_lock", True):
+        lock — but only while multi-device serialization is ACTIVE
+        (``device_lock.active()``): on a single-device process the
+        wedge class the lock exists for cannot occur (no inter-device
+        rendezvous to deadlock the execution pool), and process-wide
+        serialization of sibling server actors was the measured bulk of
+        the two-server regression (BENCH_r05 0.809x). Inactive mode
+        falls back to the table's per-instance state lock, which still
+        pairs (state, version) against the async snapshotter. Host-only
+        tables always take their own state lock — cheap (uncontended
+        except versus the snapshotter, since the actor thread is the
+        only writer) but required so the snapshotter's capture cannot
+        tear against a concurrent host-side add."""
+        if getattr(table, "needs_device_lock", True) \
+                and device_lock.active():
             return self._table_lock
         return getattr(table, "_state_lock", self._no_lock)
 
@@ -95,6 +105,14 @@ class Server(Actor):
         self.register_handler(MsgType.Request_Add, self._process_add)
         self.register_handler(MsgType.Request_BatchAdd,
                               self._process_batch_add)
+        # Hot-shard read replication (runtime/replica.py,
+        # docs/SHARDING.md): owner refresh pushes land here; the
+        # promoted-row map broadcast arrives via the communicator's
+        # per-actor clone routing.
+        self.register_handler(MsgType.Request_ReplicaSync,
+                              self._process_replica_sync)
+        self.register_handler(MsgType.Control_Replica_Map,
+                              self._process_replica_map)
         # Fault tolerance: periodic async snapshots + rejoin restore
         # (runtime/snapshot.py), enabled by -snapshot_dir.
         self._snapshots = None
@@ -202,11 +220,64 @@ class Server(Actor):
                 # (client-cache freshness anchor). Error replies stay
                 # unstamped — the worker checks the error flag first.
                 stamp_version(reply, table.version)
+                # Replica-served trailing rows (docs/SHARDING.md): the
+                # worker needs the count to find the reply's replica
+                # descriptor blob.
+                replica_rows = table.take_reply_replica_rows()
+                if replica_rows:
+                    mark_replica_reply(reply, replica_rows)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
                 raise
             finally:
                 self.send_to(actors.COMMUNICATOR, reply)
+            self._replica_flush(table)
+
+    def _replica_flush(self, table) -> None:
+        """Send whatever replica traffic the served request made due:
+        write-through refreshes of dirty promoted rows toward the
+        holders, and/or the hot-row report toward the controller."""
+        for out in table.replica_flush_if_due():
+            self.send_to(actors.COMMUNICATOR, out)
+
+    def _process_replica_sync(self, msg: Message) -> None:
+        """An owner server's refresh push for promoted rows this rank
+        holds replicas of. Fire-and-forget: no waiter exists, so no
+        reply — and no lock either, the replica store is touched only
+        from this actor thread (serve in process_get, refresh here).
+        A sync whose src is THIS rank is the communicator's failure
+        echo (the push toward a dead holder never left): re-dirty its
+        rows so the next flush re-pushes them, keeping the version
+        watermark sound."""
+        try:
+            table = self._table(msg.table_id)
+        except RuntimeError:
+            return  # rejoin gap — replica content rebuilds on the
+            # next flush cadence; nothing to NACK
+        if msg.src == self._zoo.rank:
+            table.replica_redirty(msg.data)
+            return
+        table.apply_replica_sync(msg.data)
+
+    def _process_replica_map(self, msg: Message) -> None:
+        """Promoted-row map broadcast (cloned to this actor by the
+        communicator's routing): each named table adopts its row set —
+        owner shards reply with the initial value push for their newly
+        promoted rows, holders prune demoted entries."""
+        try:
+            epoch, promoted = replica_mod.unpack_replica_map(
+                [b.as_array(np.int32) for b in msg.data])
+        except Exception:  # noqa: BLE001 - a malformed map must not
+            # kill the server loop; the next broadcast replaces it.
+            log.error("server: undecodable replica map %r", msg)
+            return
+        for table_id, rows in promoted.items():
+            if not (0 <= table_id < len(self._store)) \
+                    or table_id not in self._ready_ids:
+                continue
+            for out in self._store[table_id].apply_replica_map(epoch,
+                                                               rows):
+                self.send_to(actors.COMMUNICATOR, out)
 
     # ref: src/server.cpp:48-58
     def _process_add(self, msg: Message) -> None:
@@ -238,6 +309,7 @@ class Server(Actor):
                 raise
             finally:
                 self.send_to(actors.COMMUNICATOR, reply)
+            self._replica_flush(table)
 
     def _process_batch_add(self, msg: Message) -> None:
         """Coalesced adds: apply every sub-add, ack them all in ONE
@@ -256,6 +328,7 @@ class Server(Actor):
             reply = msg.create_reply_message()
             desc: List[int] = [0]
             err_blobs: List[Blob] = []
+            touched: dict = {}  # table_id -> table (replica flush)
 
             def record(table_id: int, msg_id: int,
                        exc: Optional[BaseException],
@@ -302,6 +375,7 @@ class Server(Actor):
                             table.version += 1
                         record(sub.table_id, sub.msg_id, None,
                                table.version)
+                        touched[sub.table_id] = table
                     except Exception as exc:  # noqa: BLE001 - per-sub
                         # failure travels back in the batch ack
                         try:
@@ -318,6 +392,8 @@ class Server(Actor):
                     reply.push(Blob(np.asarray(desc, dtype=np.int32)))
                     reply.data.extend(err_blobs)
                 self.send_to(actors.COMMUNICATOR, reply)
+            for table in touched.values():
+                self._replica_flush(table)
 
 
 class _VectorClock:

@@ -65,13 +65,13 @@ def _rank_prefix(base: str, rank: int) -> str:
 
 
 def _state_lock_of(table):
-    """The lock that pairs a HOST-ONLY table's state with its version
-    (tables/table_interface.py ``_state_lock``) — its adds run outside
-    the device lock, so the snapshotter must take this to capture or
-    restore atomically. Device-backed tables need nothing extra (their
-    adds already hold the device lock the caller takes)."""
-    if getattr(table, "needs_device_lock", True):
-        return contextlib.nullcontext()
+    """The lock that pairs a table's state with its version
+    (tables/table_interface.py ``_state_lock``). Host-only tables' adds
+    always run under it; device-backed tables' adds run under it
+    whenever multi-device serialization is inactive (the single-device
+    relaxation in ``Server._lock_for``) and under the device table lock
+    otherwise — the snapshotter takes BOTH (table lock + every state
+    lock), so the capture is atomic against adders in either mode."""
     return getattr(table, "_state_lock", contextlib.nullcontext())
 
 

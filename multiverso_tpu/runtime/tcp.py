@@ -10,7 +10,7 @@ and no libzmq:
 - every rank binds one listening socket and lazily opens one outbound
   connection per peer (full mesh, like the reference's per-peer DEALER
   sockets, ref: zmq_net.h:25-61);
-- messages travel as length-prefixed frames: ``[total u64][header 8xi32]
+- messages travel as length-prefixed frames: ``[total u64][header 9xi32]
   [nblobs u32][blob sizes u64 x n][blob bytes ...]`` — the same
   "serialize whole message into one flat buffer" shape as the reference's
   MPI path (ref: mpi_net.h:289-317), with device blobs materialized to
@@ -73,7 +73,7 @@ define_double("net_pace_mbps", 0.0,
               "the caller for blocking sends. Bench/test knob for "
               "reproducing DCN-speed behavior on localhost; 0 = off")
 
-_HDR = struct.Struct("<8i")
+_HDR = struct.Struct(f"<{HEADER_SIZE}i")
 _LEN = struct.Struct("<Q")
 _NBLOBS = struct.Struct("<I")
 

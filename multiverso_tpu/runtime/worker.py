@@ -26,11 +26,14 @@ from typing import Dict, List
 import numpy as np
 
 from ..core.message import (PEER_LOST_MARK, Message, MsgType,
-                            pack_add_batch, reply_version, take_error)
+                            pack_add_batch, replica_row_count,
+                            reply_version, take_error)
 from ..util.configure import define_bool, define_double, get_flag
+from ..util.dashboard import count as count_event
 from ..util.dashboard import monitor
 from . import actor as actors
 from . import device_lock
+from . import replica as replica_mod
 from .actor import Actor
 from .server import Server
 
@@ -71,14 +74,28 @@ class Worker(Actor):
                           and not get_flag("sync", False))
         self._pending: Dict[int, List[Message]] = {}  # dst rank -> shards
         self._pending_bytes: Dict[int, int] = {}
-        # In-flight shard requests per destination rank: (dst, table_id,
-        # msg_id) added when a shard is sent (or staged), removed when
-        # its reply lands. Written only on this actor's thread; read
-        # from requester threads for timeout diagnostics (GIL-atomic
-        # set ops; a torn read only costs diagnostic precision).
-        self._inflight: set = set()
+        # In-flight shard requests: (dst, table_id, msg_id) tracked when
+        # a shard is sent (or staged), untracked when its reply lands.
+        # Written only on this actor's thread; read from requester
+        # threads for timeout diagnostics (GIL-atomic dict ops; a torn
+        # read only costs diagnostic precision). Kept as a MULTISET
+        # (key -> outstanding count): a replica REPAIR deliberately
+        # reuses the original
+        # request's (dst, table, msg_id) toward the rows' owner, and
+        # with a plain set the original reply's discard would untrack
+        # the still-outstanding repair — the dead-peer sweep could then
+        # no longer fail its waiter (a crash mid-repair would hang
+        # wait() forever). The count is also what the sweep owes in
+        # notifies.
+        self._inflight: Dict[tuple, int] = {}
         self.register_handler(MsgType.Control_Dead_Peer,
                               self._process_dead_peer)
+        self.register_handler(MsgType.Control_Replica_Map,
+                              self._process_replica_map)
+        # Per-destination-server shard counters (bench observability:
+        # per-server request counts localize a hot shard). Plain dict,
+        # actor-thread only; read via snapshot copy.
+        self._reqs_by_dst: Dict[int, int] = {}
 
     def register_table(self, worker_table) -> int:
         self._cache.append(worker_table)
@@ -118,8 +135,32 @@ class Worker(Actor):
         with monitor("WORKER_PROCESS_ADD"):
             self._partition_and_send(msg, MsgType.Request_Add)
 
+    def request_counts(self) -> Dict[int, int]:
+        """Shards sent per destination rank (bench observability;
+        snapshot copy — the actor thread owns the dict)."""
+        return dict(self._reqs_by_dst)
+
+    def _process_replica_map(self, msg: Message) -> None:
+        """Promoted-row map broadcast from the controller: each table's
+        router adopts its row set ON THIS THREAD (the same thread that
+        partitions), so routing decisions never race the map."""
+        try:
+            epoch, promoted = replica_mod.unpack_replica_map(
+                [b.as_array(np.int32) for b in msg.data])
+        except Exception:  # noqa: BLE001 - a malformed map must not
+            # kill the worker loop; the next broadcast replaces it.
+            from ..util import log
+            log.error("worker: undecodable replica map %r", msg)
+            return
+        for table_id, rows in promoted.items():
+            if 0 <= table_id < len(self._cache):
+                self._cache[table_id].apply_replica_map(epoch, rows)
+
     def _partition_and_send(self, msg: Message, msg_type: MsgType) -> None:
         table = self._cache[msg.table_id]
+        # Partition context: tables that record per-shard routing (the
+        # replica router's repair bookkeeping) key it by request id.
+        table._partition_msg_id = msg.msg_id
         try:
             # Partitions of DEVICE-carrying requests dispatch eager
             # device ops (per-server delta slices). Those must
@@ -145,7 +186,9 @@ class Worker(Actor):
                     device_lock.settle([b.data
                                         for blobs in partitions.values()
                                         for b in blobs if b.on_device])
+            table._partition_msg_id = -1
         except Exception as exc:
+            table._partition_msg_id = -1
             # Record the failure on the request and release the caller's
             # waiter — wait() raises instead of returning 'success' over
             # an untouched destination buffer (the actor loop only logs).
@@ -195,7 +238,8 @@ class Worker(Actor):
             blobs = partitions.get(server_id)
             if blobs is not None:
                 shard.data = list(blobs)
-            self._inflight.add((dst, msg.table_id, msg.msg_id))
+            self._track((dst, msg.table_id, msg.msg_id))
+            self._reqs_by_dst[dst] = self._reqs_by_dst.get(dst, 0) + 1
             if (self._coalesce and msg_type == MsgType.Request_Add
                     and dst != self._zoo.rank):
                 self._stage_add(dst, shard)
@@ -234,6 +278,16 @@ class Worker(Actor):
         per server shard)."""
         return self._zoo.rank_to_server_id(msg.src)
 
+    def _track(self, key: tuple) -> None:
+        self._inflight[key] = self._inflight.get(key, 0) + 1
+
+    def _untrack(self, key: tuple) -> None:
+        n = self._inflight.get(key, 0)
+        if n <= 1:
+            self._inflight.pop(key, None)
+        else:
+            self._inflight[key] = n - 1
+
     def pending_peers(self, table_id: int, msg_id: int) -> List[int]:
         """Destination ranks a request is still awaiting replies from
         (timeout diagnostics; best-effort read from requester threads)."""
@@ -248,7 +302,7 @@ class Worker(Actor):
         harmlessly."""
         for key in [k for k in list(self._inflight)
                     if k[1] == table_id and k[2] == msg_id]:
-            self._inflight.discard(key)
+            self._inflight.pop(key, None)  # abandoned: drop ALL counts
 
     def _process_dead_peer(self, msg: Message) -> None:
         """A peer rank died (zoo.peer_lost): every in-flight shard
@@ -263,7 +317,7 @@ class Worker(Actor):
         staged = self._pending.pop(dead, None) or []
         self._pending_bytes.pop(dead, None)
         for shard in staged:
-            self._inflight.discard((dead, shard.table_id, shard.msg_id))
+            self._untrack((dead, shard.table_id, shard.msg_id))
             table = self._cache[shard.table_id]
             table.fail(shard.msg_id,
                        f"{PEER_LOST_MARK} rank {dead} died with this Add "
@@ -271,26 +325,41 @@ class Worker(Actor):
             table.notify(shard.msg_id)
         # list() copy: forget_request on a requester thread may discard
         # concurrently, and bare set iteration would raise on a resize.
-        lost = [key for key in list(self._inflight) if key[0] == dead]
-        for key in lost:
-            self._inflight.discard(key)
+        # Replica routing must stop striping hot rows to the corpse
+        # (fall back to owners) — otherwise every retry re-routes to
+        # the dead holder and replicated reads hard-fail while their
+        # owners are alive.
+        dead_sid = self._zoo.rank_to_server_id(dead)
+        if dead_sid >= 0:
+            for table in self._cache:
+                table.replica_server_dead(dead_sid)
+        lost = [(key, n) for key, n in list(self._inflight.items())
+                if key[0] == dead]
+        for key, n in lost:
+            self._inflight.pop(key, None)
             _dst, table_id, msg_id = key
             table = self._cache[table_id]
             table.fail(msg_id,
                        f"{PEER_LOST_MARK} rank {dead} died before "
                        f"replying (table {table_id}, msg {msg_id})",
                        count=False)
-            table.notify(msg_id)
+            for _ in range(n):  # one notify per outstanding shard
+                table.notify(msg_id)
 
     # ref: src/worker.cpp:78-84
     def _process_reply_get(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
-        self._inflight.discard((msg.src, msg.table_id, msg.msg_id))
+        self._untrack((msg.src, msg.table_id, msg.msg_id))
         # Every shard reply — error or not — counts exactly one notify
         # (the finally), so the waiter completes only after ALL shards
         # report; wait() then raises on any recorded failure. Releasing
         # early on the first error would let a late sibling reply write
-        # into a subsequent request's destination registers.
+        # into a subsequent request's destination registers. EXCEPTION:
+        # a replica-routed shard that came back short (holder missing
+        # rows / below a read-your-writes floor) TRANSFERS its notify
+        # onto the repair request(s) it stages — the waiter then
+        # completes only when the repaired rows landed too.
+        handoff = False
         try:
             error = take_error(msg)
             if error is not None:
@@ -300,12 +369,14 @@ class Worker(Actor):
                 # nothing to hand to the table — just count it down.
                 pass
             else:
-                # Reply context (origin server, version stamp, request
-                # id): lets the table attribute the payload to a shard
-                # version for the client cache and route prefetch
-                # replies — single worker thread, so plain attributes.
+                # Reply context (origin server, version stamp, replica
+                # row count, request id): lets the table attribute the
+                # payload to a shard version for the client cache and
+                # route prefetch replies — single worker thread, so
+                # plain attributes.
                 table._begin_reply(self._reply_server_id(msg),
-                                   reply_version(msg), msg.msg_id)
+                                   reply_version(msg), msg.msg_id,
+                                   replica_row_count(msg))
                 try:
                     # NOT under the table lock: reply handling may
                     # MATERIALIZE device payloads (host-buffer gets),
@@ -315,21 +386,48 @@ class Worker(Actor):
                     table.process_reply_get(msg.data)
                 finally:
                     table._end_reply()
+                handoff = self._send_repairs(table, msg)
         except Exception as exc:
             table.fail(msg.msg_id, f"reply handling failed: {exc}",
                        count=False)
             raise
         finally:
-            table.notify(msg.msg_id)
+            if not handoff:
+                table.notify(msg.msg_id)
+
+    def _send_repairs(self, table, msg: Message) -> bool:
+        """Drain the repairs ``process_reply_get`` staged (rows a
+        replica holder could not serve validly) into follow-up shard
+        requests toward the rows' OWNERS, under the SAME request id.
+        Returns True when the caller must skip this reply's notify —
+        it was transferred onto the repairs (extended by
+        ``extend_request`` when several owners are involved)."""
+        repairs = table.take_repairs()
+        if not repairs:
+            return False
+        table.extend_request(msg.msg_id, len(repairs) - 1)
+        for server_id, blobs in repairs:
+            dst = self._zoo.server_rank(server_id)
+            shard = Message(src=self._zoo.rank, dst=dst,
+                            msg_type=MsgType.Request_Get,
+                            table_id=msg.table_id, msg_id=msg.msg_id)
+            shard.data = list(blobs)
+            self._track((dst, msg.table_id, msg.msg_id))
+            self._reqs_by_dst[dst] = self._reqs_by_dst.get(dst, 0) + 1
+            count_event(replica_mod.REPLICA_REPAIR)
+            self.send_to(actors.COMMUNICATOR, shard)
+        return True
 
     # ref: src/worker.cpp:86-88
     def _process_reply_add(self, msg: Message) -> None:
         table = self._cache[msg.table_id]
-        self._inflight.discard((msg.src, msg.table_id, msg.msg_id))
+        self._untrack((msg.src, msg.table_id, msg.msg_id))
         # The piggybacked version bump must land BEFORE the notify: the
         # adder's completion callback reads the tracker to resolve its
-        # self-invalidated cache slots (read-your-writes).
-        table.note_version(self._reply_server_id(msg), reply_version(msg))
+        # self-invalidated cache slots (read-your-writes); it also
+        # raises this worker's read-your-writes floor for the shard
+        # (replica groups below the floor repair to the owner).
+        table.note_add_ack(self._reply_server_id(msg), reply_version(msg))
         error = take_error(msg)
         if error is not None:
             table.fail(msg.msg_id, error, count=False)
@@ -377,11 +475,12 @@ class Worker(Actor):
         for i in range(int(desc[0])):
             table_id, msg_id, failed, version = (
                 int(v) for v in desc[1 + 4 * i:5 + 4 * i])
-            self._inflight.discard((msg.src, table_id, msg_id))
+            self._untrack((msg.src, table_id, msg_id))
             table = self._cache[table_id]
             # Per-sub version stamp, noted before the notify (the
-            # adder's cache-resolution callback reads it).
-            table.note_version(server_id, version)
+            # adder's cache-resolution callback reads it; the
+            # read-your-writes floor rises with it).
+            table.note_add_ack(server_id, version)
             if failed:
                 text = bytes(err_blobs[err_idx].as_array(np.uint8)) \
                     .decode(errors="replace") \

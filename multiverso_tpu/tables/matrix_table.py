@@ -39,8 +39,10 @@ import jax
 import numpy as np
 
 from ..core.blob import Blob, is_device_array
-from ..core.message import MsgType
+from ..core.message import Message, MsgType
 from ..runtime import device_lock
+from ..runtime import replica as replica_mod
+from ..runtime.zoo import CONTROLLER_RANK
 from ..util.dashboard import count as count_event
 from . import client_cache
 from .client_cache import RowCache
@@ -271,6 +273,23 @@ class MatrixWorker(WorkerTable):
         self._pf_rows: Dict[int, np.ndarray] = {}
         self._pf_by_key: Dict[bytes, int] = {}
         self._pf_joined: Dict[int, List] = {}
+        # Hot-shard read replication routing (runtime/replica.py,
+        # docs/SHARDING.md): the promoted-row map re-routes the
+        # replicated subset of a host row Get to holder servers
+        # (per-row stripe, or the co-located shard when this rank
+        # hosts one); Adds always go to the owners (write-through).
+        # Dense multi-server tables only, matching the server side.
+        # _replica_sent records, per request id, which foreign rows
+        # went to which holder so each holder's reply can be diffed
+        # for repairs. Worker actor thread only.
+        self._replica_router = None
+        self._replica_sent: Dict[int, Dict[int, np.ndarray]] = {}
+        if (not self.is_sparse and self._num_server > 1
+                and replica_mod.replication_enabled()):
+            local_sid = self._zoo.rank_to_server_id(self._zoo.rank)
+            self._replica_router = replica_mod.ReplicaRouter(
+                self._num_server, salt=max(self._zoo.rank, 0),
+                preferred=local_sid if local_sid >= 0 else None)
 
     def _check_row_ids(self, row_ids: np.ndarray) -> None:
         """Fail fast in the CALLER on out-of-range ids. partition() runs
@@ -799,6 +818,24 @@ class MatrixWorker(WorkerTable):
               "row ids out of range [0, num_row)")
         is_add = msg_type == MsgType.Request_Add
         dest = np.minimum(keys // self._row_length, self._num_server - 1)
+        if (not is_add and self._replica_router is not None
+                and self._replica_router.active):
+            # Replicated (hot) rows re-route to holder servers — the
+            # co-located shard when this rank hosts one, else a
+            # per-row stripe across all servers (docs/SHARDING.md);
+            # each holder's own rows ride the same shard message.
+            # Adds never re-route — write-through keeps the owner
+            # authoritative.
+            rep_mask = self._replica_router.replicated_mask(keys)
+            if bool(rep_mask.any()):
+                dest = np.asarray(dest).copy()
+                holders = self._replica_router.route(keys[rep_mask])
+                # -1 = chosen holder declared dead: fall back to the
+                # row's OWNER (the original range dest) — correct by
+                # construction, merely unbalanced until rejoin.
+                dest[rep_mask] = np.where(holders >= 0, holders,
+                                          dest[rep_mask])
+                self._note_replica_routed(keys, dest, rep_mask)
         values = dev_values = None
         if is_add:
             if blobs[1].on_device and not self._compress:
@@ -984,9 +1021,28 @@ class MatrixWorker(WorkerTable):
             keys = reply_blobs[0].as_array(np.int32)
             values = reply_blobs[1].as_array(self.dtype).reshape(
                 keys.size, self.num_col)
+            ent = self._replica_sent.get(self._reply_msg_id)
+            if ent is not None:
+                ent.pop(self._reply_server, None)
+                if not ent:
+                    del self._replica_sent[self._reply_msg_id]
+            n_rep = self._reply_replica_rows
             if self._row_cache is not None:
-                self._row_cache.store(keys, values, self._reply_version,
+                n_own = keys.size - n_rep
+                self._row_cache.store(keys[:n_own], values[:n_own],
+                                      self._reply_version,
                                       self._reply_server)
+                # Replica groups cache under their OWNER at the group's
+                # version floor; groups below the read-your-writes
+                # floor and holder misses just stay uncached — a
+                # prefetch never repairs (a later real Get fetches
+                # whatever is still missing).
+                for owner, floor, gkeys, gvals in \
+                        self._replica_groups(keys, values, reply_blobs):
+                    if floor < self.add_floor(owner):
+                        continue
+                    self._version_tracker.note(owner, floor)
+                    self._row_cache.store(gkeys, gvals, floor, owner)
             return
         if reply_blobs[0].on_device:
             # Device-key reply: values arrive shaped
@@ -1045,6 +1101,20 @@ class MatrixWorker(WorkerTable):
                   "format was removed (docs/WIRE_FORMAT.md)")
             values = reply_blobs[1].as_array(self.dtype).reshape(
                 keys.size, self.num_col)
+        requested = None
+        ent = self._replica_sent.get(self._reply_msg_id)
+        if ent is not None:
+            # This may be a holder shard of a replica-routed request —
+            # even a reply with ZERO replica rows (the holder missed
+            # everything) must diff against what was routed to it, or
+            # the missing positions would silently stay unfilled.
+            requested = ent.pop(self._reply_server, None)
+            if not ent:
+                del self._replica_sent[self._reply_msg_id]
+        if self._reply_replica_rows or requested is not None:
+            self._process_replica_reply(keys, values, reply_blobs,
+                                        requested)
+            return
         if self._row_cache is not None and self._dest_rows is not None:
             # Wire-path population: every real row Get refreshes the
             # cache (and, via the reply context, the version tracker) —
@@ -1065,6 +1135,121 @@ class MatrixWorker(WorkerTable):
             # quadratic and a single reply can burn minutes.
             client_cache.place_rows(keys, values, self._dest_rows,
                                     self._dest)
+
+    # -- hot-shard replication: worker side (runtime/replica.py,
+    #    docs/SHARDING.md; all on the worker actor thread) --
+    def apply_replica_map(self, epoch: int, rows) -> None:
+        if self._replica_router is not None:
+            self._replica_router.apply(epoch, rows)
+
+    def replica_server_dead(self, server_id: int) -> None:
+        if self._replica_router is not None:
+            self._replica_router.mark_dead(server_id)
+
+    def replica_server_alive(self, server_id: int) -> None:
+        if self._replica_router is not None and server_id >= 0:
+            self._replica_router.mark_alive(server_id)
+
+    def _note_replica_routed(self, keys: np.ndarray, dest: np.ndarray,
+                             rep_mask: np.ndarray) -> None:
+        """Record which FOREIGN rows (owner != holder) the current
+        request routed to which holder — keyed by the request id the
+        worker actor set around ``partition`` — so each holder's reply
+        can be diffed for repairs. Rows a holder itself owns need no
+        bookkeeping (an owner always serves its rows). Entries for
+        requests that error out before their reply are reaped by the
+        size cap."""
+        if self._partition_msg_id < 0:
+            return
+        owners = np.minimum(keys // self._row_length,
+                            self._num_server - 1)
+        foreign = rep_mask & (dest != owners)
+        if not bool(foreign.any()):
+            return
+        by_holder: Dict[int, np.ndarray] = {}
+        for sid in np.unique(dest[foreign]):
+            by_holder[int(sid)] = np.unique(
+                keys[foreign & (dest == sid)]).astype(np.int32)
+        while len(self._replica_sent) > 256:
+            self._replica_sent.pop(next(iter(self._replica_sent)))
+        self._replica_sent[self._partition_msg_id] = by_holder
+
+    def _replica_groups(self, keys: np.ndarray, values: np.ndarray,
+                        reply_blobs: List[Blob]) -> List:
+        """Decode the current reply's replica descriptor (last blob)
+        into ``[(owner_sid, floor_version, group_keys, group_values)]``
+        — empty when the reply carries no replica rows."""
+        if not self._reply_replica_rows:
+            return []
+        desc = reply_blobs[-1].as_array(np.int32)
+        n_groups = int(desc[0])
+        total = int(desc[3::3][:n_groups].sum())
+        pos = keys.size - total
+        out = []
+        for g in range(n_groups):
+            owner = int(desc[1 + 3 * g])
+            floor = int(desc[2 + 3 * g]) - 1
+            n_rows = int(desc[3 + 3 * g])
+            out.append((owner, floor, keys[pos:pos + n_rows],
+                        values[pos:pos + n_rows]))
+            pos += n_rows
+        return out
+
+    def _process_replica_reply(self, keys: np.ndarray,
+                               values: np.ndarray,
+                               reply_blobs: List[Blob],
+                               requested: Optional[np.ndarray]) -> None:
+        """A holder shard's reply: owned rows attribute to the holder
+        as usual; each replica group attributes to its OWNER at the
+        group's version floor. Groups below this worker's read-your-
+        writes floor are discarded (their values may predate an Add the
+        owner already acked to us) and — together with routed rows the
+        holder did not serve at all — REPAIR to their owners under the
+        same request id (the worker actor transfers this reply's notify
+        onto the repairs, so wait() completes only when they landed)."""
+        groups = self._replica_groups(keys, values, reply_blobs)
+        n_own = keys.size - self._reply_replica_rows
+        own_keys, own_vals = keys[:n_own], values[:n_own]
+        if self._row_cache is not None and self._dest_rows is not None:
+            self._row_cache.store(own_keys, own_vals,
+                                  self._reply_version,
+                                  self._reply_server)
+        if self._dest is not None and self._dest_rows is not None:
+            client_cache.place_rows(own_keys, own_vals,
+                                    self._dest_rows, self._dest)
+        served: List[np.ndarray] = []
+        stale: List[np.ndarray] = []
+        for owner, floor, gkeys, gvals in groups:
+            if floor < self.add_floor(owner):
+                count_event(replica_mod.REPLICA_STALE, int(gkeys.size))
+                stale.append(gkeys)
+                continue
+            served.append(gkeys)
+            # Tracker note(), NOT note_version(): a floor below the
+            # owner's latest observed version is normal replica lag,
+            # not the generation-change regression signal that
+            # invalidates caches.
+            self._version_tracker.note(owner, floor)
+            if self._row_cache is not None and self._dest_rows is not None:
+                self._row_cache.store(gkeys, gvals, floor, owner)
+            if self._dest is not None and self._dest_rows is not None:
+                client_cache.place_rows(gkeys, gvals, self._dest_rows,
+                                        self._dest)
+        repair = list(stale)
+        if requested is not None:
+            got = np.concatenate(served + stale) if (served or stale) \
+                else np.empty(0, np.int32)
+            missing = np.setdiff1d(requested, got)
+            if missing.size:
+                repair.append(missing)
+        if not repair:
+            return
+        rows = np.unique(np.concatenate(repair)).astype(np.int32)
+        owners = np.minimum(rows // self._row_length,
+                            self._num_server - 1)
+        for sid in np.unique(owners):
+            chunk = np.ascontiguousarray(rows[owners == sid])
+            self._stage_repair(int(sid), [Blob(chunk.view(np.uint8))])
 
 
 class MatrixServer(ServerTable):
@@ -1132,6 +1317,17 @@ class MatrixServer(ServerTable):
         # (dirty_ids, padded device ids) of the last fused dirty get —
         # an unchanged dirty set skips the per-call id upload.
         self._dirty_dev_cache = None
+        # Hot-shard read replication (runtime/replica.py,
+        # docs/SHARDING.md): dense multi-server tables only — the
+        # sparse dirty protocol is already a per-consumer staleness
+        # tracker, and a single server owns every row. Flag read at
+        # construction time, like -sparse_compress.
+        self._replica = None
+        self._reply_replica_rows_out = 0
+        if (not self.is_sparse and self._zoo.num_servers > 1
+                and replica_mod.replication_enabled()):
+            self._replica = replica_mod.ServerReplicaState(
+                self.row_offset, self.my_rows)
 
     # -- Add (ref: matrix_table.cpp:386-418, sparse_matrix_table.cpp:200-223)
     def process_add(self, blobs: List[Blob]) -> None:
@@ -1149,6 +1345,11 @@ class MatrixServer(ServerTable):
                 self._data, blobs[0].typed(np.int32),
                 blobs[1].typed(self.dtype), option,
                 bounds=self._shard_bounds)
+            if self._replica is not None:
+                # Device-resident ids cannot be enumerated without a
+                # host sync: conservatively dirty every own promoted
+                # row for the next write-through flush.
+                self._replica.note_add_all()
             return
         keys = blobs[0].as_array(np.int32)
         if self._compress and len(blobs) in (2, 3) \
@@ -1183,6 +1384,8 @@ class MatrixServer(ServerTable):
                 _shaped_rows(delta, self.my_rows, self.num_col), option)
             if self._up_to_date is not None:
                 self._mark_dirty(slice(None), option)
+            if self._replica is not None:
+                self._replica.note_add_all()
             return
         local_rows = keys - self.row_offset
         if is_device_array(delta):
@@ -1193,6 +1396,10 @@ class MatrixServer(ServerTable):
                                              option)
         if self._up_to_date is not None:
             self._mark_dirty(local_rows, option)
+        if self._replica is not None:
+            # Write-through: promoted rows this Add touched refresh to
+            # the holders on the next flush cadence.
+            self._replica.note_add(keys)
 
     def _mark_dirty(self, rows, option: Optional[AddOption]) -> None:
         """An Add invalidates the rows for every consumer except the adder,
@@ -1240,6 +1447,17 @@ class MatrixServer(ServerTable):
                 return self._sparse_get_all(GetOption.from_blob(blobs[1]))
             return [blobs[0], Blob(self._values()),
                     Blob(np.array([self.server_id], dtype=np.int32))]
+        if self._replica is not None:
+            # Hot tracking counts every row REQUESTED here — owned or
+            # replica-routed; each row request lands on exactly one
+            # server, so the controller's aggregation stays exact and
+            # promotion cannot flap when routing moves the head to a
+            # holder.
+            self._replica.note_get(keys)
+            own_mask = (keys >= self.row_offset) \
+                & (keys < self.row_offset + self.my_rows)
+            if not bool(own_mask.all()):
+                return self._replica_row_get(keys, own_mask)
         local_rows = keys - self.row_offset
         padded_rows = pad_ids(local_rows, self._data.shape[0])
         values = _trim_rows(self._gather(self._data, padded_rows),
@@ -1249,6 +1467,146 @@ class MatrixServer(ServerTable):
             if 0 <= opt.worker_id < self._up_to_date.shape[0]:
                 self._up_to_date[opt.worker_id, local_rows] = True
         return [blobs[0]] + self._reply_values(values)
+
+    # -- hot-shard replication: holder/owner server sides
+    #    (runtime/replica.py, docs/SHARDING.md) --
+    def _replica_row_get(self, keys: np.ndarray,
+                         own_mask: np.ndarray) -> List[Blob]:
+        """Holder-side row Get carrying FOREIGN (replica-routed) rows:
+        own rows gather as usual, foreign rows serve from the host-side
+        replica store — a numpy gather, no device program. Rows the
+        store lacks are simply absent from the reply (the worker
+        repairs them to their owners). Reply layout: ``[keys = own
+        rows + group rows, values, int32 replica descriptor]`` with
+        REPLICA_SLOT stamped by the server actor."""
+        own = np.ascontiguousarray(keys[own_mask])
+        own_values = np.empty((0, self.num_col), self.dtype)
+        if own.size:
+            local = own - self.row_offset
+            padded = pad_ids(local, self._data.shape[0])
+            own_values = np.asarray(_trim_rows(
+                self._gather(self._data, padded), own.size))
+        foreign = np.unique(keys[~own_mask])
+        groups, rkeys, rvalues = self._replica.store.serve(
+            foreign, self.num_col, self.dtype)
+        count_event(replica_mod.REPLICA_HIT, int(rkeys.size))
+        count_event(replica_mod.REPLICA_MISS,
+                    int(foreign.size) - int(rkeys.size))
+        if not groups:
+            # Every foreign row missed (the owner's initial push has
+            # not landed, or a demotion raced the routing): reply the
+            # own part only; the worker repairs the rest.
+            return [Blob(own.view(np.uint8)), Blob(own_values)]
+        desc = [len(groups)]
+        for owner_sid, floor, n_rows in groups:
+            desc.extend((int(owner_sid), int(floor) + 1, int(n_rows)))
+        keys_out = np.ascontiguousarray(
+            np.concatenate([own.astype(np.int32), rkeys]))
+        values_out = np.concatenate([own_values, rvalues])
+        self._reply_replica_rows_out = int(rkeys.size)
+        return [Blob(keys_out.view(np.uint8)), Blob(values_out),
+                Blob(np.asarray(desc, dtype=np.int32))]
+
+    def take_reply_replica_rows(self) -> int:
+        n, self._reply_replica_rows_out = self._reply_replica_rows_out, 0
+        return n
+
+    def apply_replica_map(self, epoch: int, rows) -> List[Message]:
+        if self._replica is None:
+            return []
+        newly_promoted = self._replica.apply_map(epoch, rows)
+        # Owner side: newly promoted own rows get their initial value
+        # push NOW — until it lands, holders miss and workers repair.
+        return self._replica_sync_messages(newly_promoted)
+
+    def apply_replica_sync(self, blobs: List[Blob]) -> None:
+        if self._replica is None:
+            return
+        rows = blobs[0].as_array(np.int32)
+        values = blobs[1].as_array(self.dtype).reshape(rows.size,
+                                                       self.num_col)
+        meta = blobs[2].as_array(np.int32)
+        self._replica.store.apply_sync(rows, values,
+                                       owner_sid=int(meta[0]),
+                                       version=int(meta[1]) - 1,
+                                       watermark=bool(meta[2]),
+                                       seq=int(meta[3]))
+
+    def replica_redirty(self, blobs: List[Blob]) -> None:
+        if self._replica is not None and blobs:
+            self._replica.redirty(blobs[0].as_array(np.int32))
+
+    def replica_flush_if_due(self) -> List[Message]:
+        if self._replica is None:
+            return []
+        out: List[Message] = []
+        dirty = self._replica.take_due_sync()
+        if dirty is not None and (dirty.size or self.version
+                                  > self._replica.last_sync_version):
+            # An empty drain still refreshes when the shard version
+            # advanced (adds landed on NON-promoted rows): the
+            # watermark-only message re-certifies the holders' entries
+            # at the new version, or every later read-your-writes floor
+            # would read them as stale forever.
+            out.extend(self._replica_sync_messages(dirty))
+        report = self._replica.take_due_report()
+        if report is not None:
+            msg = Message(src=self._zoo.rank, dst=CONTROLLER_RANK,
+                          msg_type=MsgType.Control_Replica_Report,
+                          table_id=self.table_id)
+            msg.push(Blob(report[0]))
+            msg.push(Blob(report[1]))
+            out.append(msg)
+        return out
+
+    def _replica_sync_messages(self, rows: np.ndarray) -> List[Message]:
+        """Write-through fan-out: Request_ReplicaSync carrying current
+        values + this shard's version for own promoted ``rows``, one
+        message per holder server (chunked at -replica_sync_rows; the
+        LAST chunk carries the watermark flag — ``rows`` must be the
+        complete drained dirty set for the watermark to be sound, and
+        an empty ``rows`` sends one watermark-only message). Runs on
+        the server actor thread OUTSIDE the table lock — the gather
+        dispatch takes the device guard itself."""
+        cap = max(int(get_flag("replica_sync_rows")), 1)
+        self._replica.last_sync_version = self.version
+        out: List[Message] = []
+        n_chunks = max((int(rows.size) + cap - 1) // cap, 1)
+        chunks: List[tuple] = []
+        for c in range(n_chunks):
+            chunk = np.ascontiguousarray(rows[c * cap:(c + 1) * cap])
+            if chunk.size:
+                local = chunk - self.row_offset
+                padded = pad_ids(local, self._data.shape[0])
+                with device_lock.guard():
+                    gathered = device_lock.settle(
+                        self._gather(self._data, padded))
+                values = np.asarray(_trim_rows(gathered, chunk.size))
+            else:
+                values = np.empty((0, self.num_col), self.dtype)
+            chunks.append((chunk, values))
+            count_event(replica_mod.REPLICA_SYNC)
+        for sid in range(self._zoo.num_servers):
+            if sid == self.server_id:
+                continue
+            for c, (chunk, values) in enumerate(chunks):
+                # meta: [owner_sid, version+1, watermark, seq]. The
+                # per-HOLDER seq is consecutive; a holder seeing a gap
+                # drops this owner's entries before applying (a lost
+                # chunk must not be papered over by this watermark).
+                meta = np.asarray(
+                    [self.server_id, self.version + 1,
+                     1 if c == n_chunks - 1 else 0,
+                     self._replica.next_sync_seq(sid)], dtype=np.int32)
+                msg = Message(src=self._zoo.rank,
+                              dst=self._zoo.server_rank(sid),
+                              msg_type=MsgType.Request_ReplicaSync,
+                              table_id=self.table_id)
+                msg.push(Blob(chunk.view(np.uint8)))
+                msg.push(Blob(values))
+                msg.push(Blob(meta))
+                out.append(msg)
+        return out
 
     def _reply_values(self, values) -> List[Blob]:
         """Get replies run through the wire filter for sparse tables

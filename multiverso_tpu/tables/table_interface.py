@@ -85,6 +85,24 @@ class WorkerTable:
         self._reply_server = -1
         self._reply_version = -1
         self._reply_msg_id = -1
+        self._reply_replica_rows = 0
+        # Read-your-writes floors per server shard: the latest version
+        # OUR OWN Add acks carried. A replica-served group whose floor
+        # is below this would hand back pre-write values of rows this
+        # worker already saw acknowledged — those rows repair to the
+        # owner instead (docs/SHARDING.md). Written/read on the worker
+        # actor thread only.
+        self._add_floor: Dict[int, int] = {}
+        # Replica repair staging: process_reply_get (worker actor
+        # thread) records (owner_server_id, request_blobs) follow-ups
+        # for rows a replica holder could not serve validly; the worker
+        # actor drains them via take_repairs and transfers the reply's
+        # notify onto the follow-up requests.
+        self._pending_repairs: List = []
+        # Request id of the partition in progress (set by the worker
+        # actor around ``partition``): replica-routing tables key their
+        # per-request routing bookkeeping by it.
+        self._partition_msg_id = -1
 
     # -- public sync API (ref: src/table.cpp:29-38) --
     def get_raw(self, keys: Blob, extra: Sequence[Blob] = ()) -> None:
@@ -376,17 +394,75 @@ class WorkerTable:
                 cache.invalidate_server(server_id)
         self._version_tracker.note(server_id, version)
 
+    def note_add_ack(self, server_id: int, version: int) -> None:
+        """An Add ack from a server shard: raises this worker's
+        read-your-writes floor for that shard (replica-served groups
+        below the floor repair to the owner) in addition to the normal
+        version observation."""
+        if version >= 0:
+            floor = self._add_floor.get(server_id, -1)
+            if version > floor:
+                self._add_floor[server_id] = version
+        self.replica_server_alive(server_id)
+        self.note_version(server_id, version)
+
+    def add_floor(self, server_id: int) -> int:
+        return self._add_floor.get(server_id, -1)
+
     def _begin_reply(self, server_id: int, version: int,
-                     msg_id: int) -> None:
+                     msg_id: int, replica_rows: int = 0) -> None:
         """Reply context for ``process_reply_get`` (single worker-actor
-        thread — plain attributes, no lock needed)."""
+        thread — plain attributes, no lock needed). ``replica_rows``
+        is the REPLICA_SLOT count: how many trailing rows of the reply
+        were served from a replica store (their versions ride the
+        reply's replica descriptor, not the header version slot)."""
         self._reply_server = server_id
         self._reply_version = version
         self._reply_msg_id = msg_id
+        self._reply_replica_rows = int(replica_rows)
+        self.replica_server_alive(server_id)
         self.note_version(server_id, version)
 
     def _end_reply(self) -> None:
         self._reply_server = self._reply_version = self._reply_msg_id = -1
+        self._reply_replica_rows = 0
+
+    # -- hot-shard replication plumbing (runtime/replica.py) --
+    def apply_replica_map(self, epoch: int, rows) -> None:
+        """Promoted-row map broadcast (worker actor thread). Default:
+        tables that don't participate in replication ignore it."""
+
+    def replica_server_dead(self, server_id: int) -> None:
+        """Control_Dead_Peer for a server rank (worker actor thread):
+        replica routing must stop striping hot rows to the corpse and
+        fall back to owners. Default no-op."""
+
+    def replica_server_alive(self, server_id: int) -> None:
+        """A reply from this server landed — re-include it in replica
+        routing (rejoin recovery). Default no-op."""
+
+    def _stage_repair(self, server_id: int, blobs: List[Blob]) -> None:
+        """Record a follow-up shard request toward ``server_id`` for
+        rows the current reply could not serve validly (replica miss /
+        stale floor). Called from ``process_reply_get``; the worker
+        actor drains the staged repairs and transfers the reply's
+        notify onto them, so the request's waiter completes only when
+        the repaired rows landed too."""
+        self._pending_repairs.append((int(server_id), list(blobs)))
+
+    def take_repairs(self) -> List:
+        repairs, self._pending_repairs = self._pending_repairs, []
+        return repairs
+
+    def extend_request(self, msg_id: int, extra: int) -> None:
+        """Raise a request's expected reply count by ``extra`` (repair
+        fan-out to several owners replaces ONE reply's notify)."""
+        if extra <= 0:
+            return
+        with self._mutex:
+            waiter = self._waitings.get(msg_id)
+        if waiter is not None:
+            waiter.add_waits(extra)
 
     # -- virtuals (ref: table_interface.h:44-51) --
     def partition(self, blobs: List[Blob],
@@ -440,6 +516,39 @@ class ServerTable:
 
     def process_get(self, blobs: List[Blob]) -> List[Blob]:
         raise NotImplementedError
+
+    # -- hot-shard replication hooks (runtime/replica.py; server actor
+    #    thread only — no locking on the replica state) --
+    def apply_replica_map(self, epoch: int, rows) -> List[Message]:
+        """Promoted-row map broadcast: owners start/stop the
+        write-through fan-out for their rows, holders prune demoted
+        entries. Returns outbound messages for the server actor to
+        send (the initial value push for newly promoted own rows).
+        Default: table types that don't replicate ignore it."""
+        return []
+
+    def apply_replica_sync(self, blobs: List[Blob]) -> None:
+        """An owner's Request_ReplicaSync refresh push; default drop
+        (a non-replicating table should never receive one)."""
+
+    def replica_redirty(self, blobs: List[Blob]) -> None:
+        """The communicator's failure echo for a sync push that never
+        left this rank: the owner must re-dirty the chunk's rows so the
+        next flush re-pushes them (the version watermark is only sound
+        when no dirtied row is silently lost). Default no-op."""
+
+    def replica_flush_if_due(self) -> List[Message]:
+        """Cadence hook, called by the server actor after each served
+        request: returns the due outbound messages — write-through
+        refreshes of dirty promoted rows toward the holders and/or the
+        hot-row window report toward the controller. Default no-op."""
+        return []
+
+    def take_reply_replica_rows(self) -> int:
+        """How many trailing rows of the reply just built by
+        ``process_get`` were replica-served (the server actor stamps
+        REPLICA_SLOT with it); self-clearing. Default 0."""
+        return 0
 
     def store(self, stream) -> None:
         raise NotImplementedError

@@ -40,6 +40,14 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     # -- server / worker actors --
     "backup_worker_ratio": 0.0,
     "coalesce_adds": True,
+    # -- sharding / scale-out (runtime/communicator.py,
+    #    runtime/replica.py; docs/SHARDING.md) --
+    "dispatch_queues": True,
+    "replica_hot_rows": 0,
+    "replica_report_gets": 256,
+    "replica_min_gets": 8,
+    "replica_sync_rows": 8192,
+    "replica_sync_every": 8,
     # -- fault tolerance (runtime/snapshot.py, runtime/controller.py,
     #    runtime/zoo.py, runtime/worker.py, runtime/tcp.py) --
     "snapshot_interval_s": 0.0,

@@ -44,6 +44,12 @@ class Monitor:
             self._count += 1
             self._elapsed_ms += elapsed_ms
 
+    def add_count(self, n: int) -> None:
+        """Bulk count bump with no elapsed time (row-granular event
+        counters — replica hit/miss rows per reply)."""
+        with self._lock:
+            self._count += n
+
     @property
     def count(self) -> int:
         return self._count
@@ -128,12 +134,88 @@ class monitor:
         return None
 
 
-def count(name: str) -> None:
-    """Bump a named counter — a Monitor used purely for its call count
-    (elapsed stays 0). The client cache's hit/miss/join counters ride
-    the same registry as the timing monitors so ``Dashboard.display()``
-    shows them side by side."""
-    Dashboard.get(name).add(0.0)
+class Samples:
+    """Bounded reservoir of per-op scalar samples (latencies, queue
+    depths) with percentile readout — the p50/p99 companion to the
+    cumulative ``Monitor``. Ring-buffer overwrite past ``cap`` keeps the
+    cost O(1) per sample and the memory bounded; percentiles are then
+    over the most recent ``cap`` observations, which is what a bench
+    window wants anyway."""
+
+    def __init__(self, name: str, cap: int = 8192):
+        self.name = name
+        self._cap = int(cap)
+        self._buf: list = []
+        self._next = 0
+        self._total = 0
+        self._lock = named_lock(f"dashboard.samples[{name}]")
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            if len(self._buf) < self._cap:
+                self._buf.append(float(value))
+            else:
+                self._buf[self._next] = float(value)
+                self._next = (self._next + 1) % self._cap
+            self._total += 1
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    def percentile(self, p: float) -> float:
+        """The p-th percentile (0-100) of the retained window; 0.0 when
+        empty."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return 0.0
+        idx = min(int(len(data) * p / 100.0), len(data) - 1)
+        return data[idx]
+
+    def snapshot(self) -> dict:
+        """Bench-friendly summary: count + p50/p90/p99/max."""
+        with self._lock:
+            data = sorted(self._buf)
+            total = self._total
+        if not data:
+            return {"count": total}
+
+        def pick(p):
+            return data[min(int(len(data) * p / 100.0), len(data) - 1)]
+
+        return {"count": total, "p50": pick(50), "p90": pick(90),
+                "p99": pick(99), "max": data[-1]}
+
+
+_samples: Dict[str, Samples] = {}
+_samples_lock = named_lock("dashboard.samples_registry")
+
+
+def samples(name: str, cap: int = 8192) -> Samples:
+    """Registry accessor for ``Samples`` (mirrors ``Dashboard.get``)."""
+    with _samples_lock:
+        s = _samples.get(name)
+        if s is None:
+            s = Samples(name, cap)
+            _samples[name] = s
+        return s
+
+
+def reset_samples() -> None:
+    with _samples_lock:
+        _samples.clear()
+
+
+def count(name: str, n: int = 1) -> None:
+    """Bump a named counter by ``n`` — a Monitor used purely for its
+    call count (elapsed stays 0). The client cache's hit/miss/join
+    counters ride the same registry as the timing monitors so
+    ``Dashboard.display()`` shows them side by side. ``n`` > 1 serves
+    row-granular counters (replica hit/miss rows per reply) without a
+    per-row Python loop."""
+    if n > 0:
+        Dashboard.get(name).add_count(n)
 
 
 def trace_to(log_dir: str):

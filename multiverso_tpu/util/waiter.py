@@ -40,6 +40,22 @@ class Waiter:
             if self._num_wait <= 0:
                 self._cond.notify_all()
 
+    def add_waits(self, k: int) -> None:
+        """Raise the pending count by ``k`` — the replica-repair path:
+        one shard reply is being REPLACED by ``k+1`` follow-up shards
+        (the worker actor suppresses that reply's notify and sends the
+        follow-ups), so the waiter must expect the extras. Only valid
+        while at least one notify is still outstanding and only from
+        the thread that would have delivered it (the worker actor):
+        a completed waiter must never be re-armed this way."""
+        with self._cond:
+            if self._num_wait <= 0:
+                # Completed (an abort's release raced the repair):
+                # re-arming would strand the releaser — drop the
+                # extension; the repair replies land as no-ops.
+                return
+            self._num_wait += k
+
     def release(self) -> None:
         """Force-complete: wake every waiter regardless of pending count
         (abort path — the caller records why)."""

@@ -393,12 +393,22 @@ def test_rpc_timeout_raises_diagnostic_naming_peer():
     table.add(np.ones(16, np.float32))
     # Wedge the server actor: its table logic serializes on the device
     # table lock, which the test thread holds — no reply can form.
+    # Server._lock_for only routes through TABLE_LOCK while multi-zoo
+    # serialization is ACTIVE (the ISSUE-7 single-process relaxation),
+    # so activate it for the wedge window.
+    device_lock.enable()
+    # Precondition, not a formality: enable() is a no-op on a
+    # single-device process (ISSUE-7 relaxation), where TABLE_LOCK
+    # would not wedge the server and the raises below would fail —
+    # this test requires the conftest 8-virtual-device mesh.
+    assert device_lock.active()
     device_lock.TABLE_LOCK.acquire()
     try:
         with pytest.raises(RpcTimeoutError) as err:
             table.get()
     finally:
         device_lock.TABLE_LOCK.release()
+        device_lock.disable()
     text = str(err.value)
     assert "table 0" in text and "peers pending" in text and "0" in text
     # The wedged reply lands late and harmlessly; serving resumes.
@@ -469,6 +479,8 @@ def test_rpc_timeout_reaps_abandoned_request_state():
     table = mv.create_array_table(16)
     table.add(np.ones(16, np.float32))
     worker = mv.current_zoo()._actors[actors.WORKER]
+    device_lock.enable()  # see test above: make the wedge lock live
+    assert device_lock.active()
     device_lock.TABLE_LOCK.acquire()
     try:
         with pytest.raises(RpcTimeoutError):
@@ -478,6 +490,7 @@ def test_rpc_timeout_reaps_abandoned_request_state():
         assert not worker._inflight
     finally:
         device_lock.TABLE_LOCK.release()
+        device_lock.disable()
     mv.shutdown()
 
 

@@ -181,6 +181,11 @@ class TestAddCoalescing:
         def note_version(self, server_id, version):
             self.events.append(("version", server_id, version))
 
+        def note_add_ack(self, server_id, version):
+            # Add acks carry the version AND raise the RYW floor
+            # (table_interface.note_add_ack); the fake only records.
+            self.events.append(("version", server_id, version))
+
         def abort(self, reason):
             self.events.append(("abort", reason))
 
