@@ -59,6 +59,16 @@ echo "== sharding subset (routing equivalence / hot-shard replication) =="
 # (tests/test_sharding.py; docs/SHARDING.md).
 python -m pytest tests/test_sharding.py -x -q
 
+echo "== obs subset (tracing / metrics export / scrape surface) =="
+# Observability invariants get their own named gate: trace-id sampling
+# and wire propagation (TRACE_SLOT, byte-identity when off), the span
+# ring buffer + slow-request watchdog, snapshot/cluster aggregation +
+# Prometheus text exposition validity, the /metrics//trace.json HTTP
+# surface, and the 3-process TCP integration proof (cross-rank nested
+# Get trace; cluster SERVER_PROCESS_GET == sum of per-rank dumps).
+# docs/OBSERVABILITY.md.
+python -m pytest tests/test_observability.py -x -q -m 'not slow'
+
 echo "== fault-tolerance subset (snapshots / rejoin / backup workers) =="
 # Crash-survival invariants get their own named gate: async snapshot
 # consistency + restore, dead-peer containment and retry, the BSP

@@ -64,8 +64,14 @@ class MsgType(enum.IntEnum):
     # consume it).
     Control_Replica_Report = 37
     Control_Replica_Map = -37
+    # Observability control plane (docs/OBSERVABILITY.md): each rank
+    # ships its Dashboard/Samples snapshot (+ new trace events) to the
+    # controller every -metrics_interval_s. Controller band (>32),
+    # fire-and-forget — no reply type pairs with it.
+    Control_Metrics = 38
 
-HEADER_SIZE = 9  # ints (8 in the reference; slot 8 added for replication)
+HEADER_SIZE = 10  # ints (8 in the reference; slot 8 added for
+#                   replication, slot 9 for request tracing)
 
 
 class Message:
@@ -144,6 +150,10 @@ class Message:
         reply = Message(src=self.dst, dst=self.src,
                         msg_type=MsgType(-self.header[2]),
                         table_id=self.table_id, msg_id=self.msg_id)
+        # The reply leg belongs to the same sampled request: carrying
+        # the trace id back lets the requester's rank pair reply-side
+        # spans under one trace (0 = unsampled, the common case).
+        reply.header[TRACE_SLOT] = self.header[TRACE_SLOT]
         return reply
 
     def __repr__(self) -> str:
@@ -217,6 +227,7 @@ WIRE_SLOTS: dict = {
     "CODEC_SLOT": 6,
     "VERSION_SLOT": 7,
     "REPLICA_SLOT": 8,
+    "TRACE_SLOT": 9,
 }
 
 assert ERROR_SLOT == WIRE_SLOTS["ERROR_SLOT"]
@@ -252,6 +263,30 @@ def replica_row_count(msg: "Message") -> int:
     return raw - 1 if raw > 0 else 0
 
 
+# Header slot 9 carries the DISTRIBUTED TRACE ID of a sampled request
+# (util/tracing.py, docs/OBSERVABILITY.md): 0 — the header default, and
+# the only value a -trace_sample_rate=0 build (or a pre-trace peer)
+# ever sends — means "unsampled"; a nonzero id is carried verbatim on
+# every shard/batch/reply message the request spawns so span events
+# recorded on different ranks pair under one trace. Growing the header
+# from 9 to 10 ints is a declared WIRE BREAK for mixed-build TCP
+# clusters (docs/WIRE_FORMAT.md), the same class as the PR-7 slot-8
+# bump.
+TRACE_SLOT = 9
+
+assert TRACE_SLOT == WIRE_SLOTS["TRACE_SLOT"]
+
+
+def stamp_trace(msg: "Message", trace_id: int) -> None:
+    msg.header[TRACE_SLOT] = int(trace_id)
+
+
+def trace_of(msg: "Message") -> int:
+    """The trace id a message carries (0 = unsampled / pre-trace
+    peer)."""
+    return int(msg.header[TRACE_SLOT])
+
+
 def stamp_version(reply: "Message", version: int) -> None:
     reply.header[VERSION_SLOT] = int(version) + 1
 
@@ -279,6 +314,14 @@ def pack_add_batch(subs: List["Message"]) -> "Message":
     first = subs[0]
     batch = Message(src=first.src, dst=first.dst,
                     msg_type=MsgType.Request_BatchAdd)
+    for sub in subs:
+        # The batch inherits the first SAMPLED sub's trace id: a trace
+        # that lands in a coalesced flush keeps its wire spans (the
+        # batch is that sub's wire message; sibling sampled subs are
+        # attributed by their own issue/reply spans).
+        if sub.header[TRACE_SLOT]:
+            batch.header[TRACE_SLOT] = sub.header[TRACE_SLOT]
+            break
     desc = [len(subs)]
     for sub in subs:
         desc.extend((sub.table_id, sub.msg_id, len(sub.data)))

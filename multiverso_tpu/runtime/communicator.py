@@ -22,8 +22,9 @@ import numpy as np
 from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             is_controller_bound, is_server_bound,
-                            is_wire_encoded, is_worker_bound, mark_error)
-from ..util import log
+                            is_wire_encoded, is_worker_bound, mark_error,
+                            trace_of)
+from ..util import log, tracing
 from ..util.configure import define_bool, get_flag
 from ..util.dashboard import samples
 from ..util.lock_witness import named_condition, named_lock
@@ -117,8 +118,15 @@ class _DispatchQueues:
                              self._queued_bytes.get(dst, 0))
             self._queued_bytes[dst] = \
                 self._queued_bytes.get(dst, 0) + nbytes
-        samples(f"DISPATCH_QUEUE_DEPTH[d{dst}]").add(queue.size())
-        queue.push((time.perf_counter(), nbytes, msg))
+        depth = queue.size()
+        samples(f"DISPATCH_QUEUE_DEPTH[d{dst}]").add(depth)
+        tid = trace_of(msg)
+        if tid:  # untraced messages (the default) pay one int check
+            tracing.event(tid, "dispatch_enqueue",
+                          self._comm._zoo.rank,
+                          args={"dst": dst, "depth": depth})
+        queue.push((time.perf_counter(),
+                    tracing.now_ns() if tid else 0, nbytes, msg))
 
     def _main(self, dst: int, queue: MtQueue) -> None:
         lat = samples(f"DISPATCH_MS[d{dst}]")
@@ -126,7 +134,15 @@ class _DispatchQueues:
             item = queue.pop()
             if item is None:
                 break
-            queued_at, nbytes, msg = item
+            queued_at, queued_ns, nbytes, msg = item
+            if queued_ns:  # sampled (nonzero only when enqueue traced)
+                # Dequeue span: the time this frame spent waiting in
+                # the per-destination queue (queue-vs-wire attribution
+                # in the merged trace).
+                tracing.add_span(trace_of(msg), "dispatch_queue_wait",
+                                 self._comm._zoo.rank, queued_ns,
+                                 tracing.now_ns() - queued_ns,
+                                 args={"dst": dst})
             try:
                 self._comm._encode_and_send(msg)
             except Exception:  # noqa: BLE001 - _encode_and_send already
@@ -387,6 +403,11 @@ class Communicator(Actor):
                     self._zoo.route(name, copy)
             return
         if is_server_bound(msg_type):
+            # Hop marker for sampled requests: the gap between this
+            # enqueue and the server span's start is mailbox queue time
+            # in the merged trace.
+            tracing.event(trace_of(msg), "server_mailbox_enqueue",
+                          self._zoo.rank)
             try:
                 self._zoo.route(actors.SERVER, msg)
             except RuntimeError as exc:

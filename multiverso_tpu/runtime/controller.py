@@ -39,6 +39,7 @@ from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             mark_error)
 from ..core.node import Node, is_server, is_worker
+from . import metrics as metrics_mod
 from . import replica as replica_mod
 from ..util import log
 from ..util.configure import define_double, get_flag
@@ -97,6 +98,24 @@ class Controller(Actor):
         self._replicas = replica_mod.ReplicaCoordinator()
         self.register_handler(MsgType.Control_Replica_Report,
                               self._process_replica_report)
+        # Observability: per-rank metric reports merge into the cluster
+        # view the -metrics_port scrape surface serves
+        # (runtime/metrics.py, docs/OBSERVABILITY.md).
+        self.metrics = metrics_mod.ClusterMetrics()
+        self.register_handler(MsgType.Control_Metrics,
+                              self._process_metrics)
+
+    def _process_metrics(self, msg: Message) -> None:
+        """A rank's periodic metrics snapshot (fire-and-forget; also
+        counts as liveness traffic — a reporting rank is an alive
+        rank)."""
+        self._note_alive(msg.src)
+        payload = metrics_mod.parse_report(msg)
+        if payload is None:
+            log.error("controller: undecodable metrics report from "
+                      "rank %d", msg.src)
+            return
+        self.metrics.ingest(payload)
 
     # -- liveness bookkeeping --
     def _note_alive(self, rank: int) -> None:

@@ -22,8 +22,8 @@ import numpy as np
 from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType, mark_error,
                             mark_replica_reply, stamp_version,
-                            unpack_add_batch)
-from ..util import log
+                            trace_of, unpack_add_batch)
+from ..util import log, tracing
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
@@ -189,7 +189,10 @@ class Server(Actor):
 
     # ref: src/server.cpp:36-46
     def _process_get(self, msg: Message) -> None:
-        with monitor("SERVER_PROCESS_GET"):
+        with monitor("SERVER_PROCESS_GET"), \
+                tracing.span(trace_of(msg), "server_process_get",
+                             self._zoo.rank,
+                             args={"table": msg.table_id}):
             reply = msg.create_reply_message()
             # The reply goes out even if table logic raises — a swallowed
             # reply would deadlock the requester's waiter forever — and a
@@ -206,7 +209,9 @@ class Server(Actor):
                     # vector clock).
                     return
                 table = self._table(msg.table_id)
-                with self._lock_for(table):
+                with self._lock_for(table), \
+                        tracing.span(trace_of(msg), "table_op:get",
+                                     self._zoo.rank):
                     reply.data = table.process_get(msg.data)
                     # Multi-zoo mode: the gather must finish before the
                     # lock releases, or its execution overlaps a sibling
@@ -281,7 +286,10 @@ class Server(Actor):
 
     # ref: src/server.cpp:48-58
     def _process_add(self, msg: Message) -> None:
-        with monitor("SERVER_PROCESS_ADD"):
+        with monitor("SERVER_PROCESS_ADD"), \
+                tracing.span(trace_of(msg), "server_process_add",
+                             self._zoo.rank,
+                             args={"table": msg.table_id}):
             reply = msg.create_reply_message()
             try:
                 if not msg.data:
@@ -289,7 +297,9 @@ class Server(Actor):
                     # bump — nothing was applied.
                     return
                 table = self._table(msg.table_id)
-                with self._lock_for(table):
+                with self._lock_for(table), \
+                        tracing.span(trace_of(msg), "table_op:add",
+                                     self._zoo.rank):
                     table.process_add(msg.data)
                     # Multi-zoo mode: the update program (new table
                     # state) must land before the lock releases.
@@ -324,7 +334,9 @@ class Server(Actor):
         _process_get/_process_add above) — so a batch whose payload
         blobs fail to unpack still acks each sub the descriptor names,
         all marked failed."""
-        with monitor("SERVER_PROCESS_BATCH_ADD"):
+        with monitor("SERVER_PROCESS_BATCH_ADD"), \
+                tracing.span(trace_of(msg), "server_process_batch_add",
+                             self._zoo.rank):
             reply = msg.create_reply_message()
             desc: List[int] = [0]
             err_blobs: List[Blob] = []

@@ -10,7 +10,7 @@ and no libzmq:
 - every rank binds one listening socket and lazily opens one outbound
   connection per peer (full mesh, like the reference's per-peer DEALER
   sockets, ref: zmq_net.h:25-61);
-- messages travel as length-prefixed frames: ``[total u64][header 9xi32]
+- messages travel as length-prefixed frames: ``[total u64][header 10xi32]
   [nblobs u32][blob sizes u64 x n][blob bytes ...]`` — the same
   "serialize whole message into one flat buffer" shape as the reference's
   MPI path (ref: mpi_net.h:289-317), with device blobs materialized to
@@ -37,8 +37,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.blob import Blob
-from ..core.message import HEADER_SIZE, Message
-from ..util import log
+from ..core.message import HEADER_SIZE, Message, trace_of
+from ..util import log, tracing
 from ..util.configure import (define_double, define_int, define_string,
                               get_flag)
 from ..util.dashboard import monitor
@@ -319,10 +319,16 @@ class TcpNet(NetInterface):
             # FIFO with earlier async frames: a sync frame overtaking
             # queued async ones would reorder the peer's stream.
             writer.flush(timeout=60.0)
-        with monitor("tcp_serialize"):
+        tid = trace_of(msg)
+        with monitor("tcp_serialize"), \
+                tracing.span(tid, "tcp_serialize", self._rank):
             frame = _serialize(msg)
         try:
-            with monitor("tcp_send"):
+            with monitor("tcp_send"), \
+                    tracing.span(tid, "tcp_send", self._rank,
+                                 args={"dst": dst,
+                                       "bytes": len(frame)}
+                                 if tid else None):
                 with self._out_locks[dst]:
                     sock = self._connect(dst)
                     self._pace(len(frame))
@@ -344,8 +350,16 @@ class TcpNet(NetInterface):
         dst = msg.dst
         if not 0 <= dst < self.size:
             raise ValueError(f"bad dst rank {dst}")
-        with monitor("tcp_serialize"):
+        tid = trace_of(msg)
+        with monitor("tcp_serialize"), \
+                tracing.span(tid, "tcp_serialize", self._rank):
             frame = _serialize(msg)
+        if tid:
+            # The actual socket write happens on the writer thread,
+            # which only sees bytes — the submit marker is the async
+            # path's wire hop for sampled traces.
+            tracing.event(tid, "tcp_send_async_submit", self._rank,
+                          args={"dst": dst, "bytes": len(frame)})
         self._writer(dst).submit(frame)
         return len(frame)
 
@@ -565,12 +579,20 @@ class TcpNet(NetInterface):
                 if total == 0:  # goodbye frame: graceful peer close
                     clean = True
                     return
+                t0_ns = tracing.now_ns()
                 with monitor("tcp_recv"):
                     body = _read_exact(conn, total)
                 if body is None:
                     return
                 with monitor("tcp_deserialize"):
                     msg = _deserialize(body)
+                tid = trace_of(msg)
+                if tid:
+                    # The trace id is only known after the parse; the
+                    # span still covers the read+deserialize window.
+                    tracing.add_span(tid, "tcp_recv", self._rank,
+                                     t0_ns, tracing.now_ns() - t0_ns,
+                                     args={"bytes": total})
                 # Every inbound frame names its sender; remembering it
                 # lets a dirty close report WHICH peer died (the zoo's
                 # rejoin path fails only that rank's in-flight requests

@@ -100,6 +100,9 @@ class Zoo:
         self._dead_peers: set = set()
         self._heartbeat = None  # HeartbeatMonitor when enabled
         self._last_controller_reply = 0.0
+        # -- observability (runtime/metrics.py, io/metrics_http.py) --
+        self._metrics_reporter = None
+        self._metrics_http = None
 
     # -- lifecycle (ref: src/zoo.cpp:41-60) --
     def start(self, argv: Optional[List[str]] = None,
@@ -129,14 +132,50 @@ class Zoo:
                 from .controller import HeartbeatMonitor
                 self._heartbeat = HeartbeatMonitor(self)
                 self._heartbeat.start()
+            self._start_observability()
         self._started = True
         log.debug("Rank %d: multiverso started", self.rank)
         return remaining
+
+    def _start_observability(self) -> None:
+        """Metrics export (-metrics_interval_s) + the controller-rank
+        scrape surface (-metrics_port). After registration, so reports
+        can route; no-ops at the default flag values."""
+        if float(get_flag("metrics_interval_s", 0.0)) > 0:
+            from .metrics import MetricsReporter
+            self._metrics_reporter = MetricsReporter(self)
+            self._metrics_reporter.start()
+        port = int(get_flag("metrics_port", 0))
+        if port > 0 and self.rank == CONTROLLER_RANK:
+            from ..io.metrics_http import (MetricsHttpServer,
+                                           json_route,
+                                           prometheus_route)
+            controller = self._actors.get(actors.CONTROLLER)
+            if controller is not None:
+                self._metrics_http = MetricsHttpServer(port, {
+                    "/metrics": prometheus_route(
+                        controller.metrics.prometheus_text),
+                    "/trace.json": json_route(
+                        controller.metrics.chrome_trace_json),
+                })
+
+    def metrics_flush(self) -> None:
+        """One immediate metrics report from this rank (deterministic
+        final cut before a scrape — pair with a barrier); no-op when
+        the reporter is off."""
+        if self._metrics_reporter is not None:
+            self._metrics_reporter.flush()
 
     def stop(self, finalize_net: bool = True) -> None:
         """ref: src/zoo.cpp:52-60,104-114."""
         if not self._started:
             return
+        if self._metrics_reporter is not None:
+            self._metrics_reporter.stop()
+            self._metrics_reporter = None
+        if self._metrics_http is not None:
+            self._metrics_http.stop()
+            self._metrics_http = None
         if self._heartbeat is not None:
             self._heartbeat.stop()
             self._heartbeat = None

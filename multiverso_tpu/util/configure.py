@@ -81,6 +81,13 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "updater_type": "default",
     # -- diagnostics (util/lock_witness.py) --
     "debug_locks": False,
+    # -- observability (util/tracing.py, runtime/metrics.py,
+    #    io/metrics_http.py; docs/OBSERVABILITY.md) --
+    "trace_sample_rate": 0.0,
+    "trace_slow_ms": 0.0,
+    "trace_buffer": 4096,
+    "metrics_interval_s": 0.0,
+    "metrics_port": 0,
     # -- wordembedding model (models/wordembedding/) --
     "train_file": "",
     "output_file": "vectors.txt",

@@ -11,11 +11,69 @@ so monitored regions show up in xprof.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from .lock_witness import named_lock
+
+#: CANONICAL METRIC-NAME REGISTRY — the one name-and-meaning table for
+#: every ``monitor("X")`` / ``samples("X")`` / ``count("X")`` literal
+#: in the tree. ``tools/mvlint``'s metric-name pass parses this literal
+#: (never imports) and fails CI on any call site naming an unlisted
+#: metric, and cross-checks the table against the metric table in
+#: ``docs/OBSERVABILITY.md`` in both directions. A trailing ``*``
+#: matches a per-destination / per-table FAMILY suffix
+#: (``DISPATCH_MS[d*]`` covers ``DISPATCH_MS[d0]``, ``DISPATCH_MS[d7]``,
+#: ...). Keep the literal plain (no computed values).
+METRIC_NAMES: Dict[str, str] = {
+    # -- worker actor / table layer --
+    "WORKER_PROCESS_GET": "worker actor Get partition+send handling",
+    "WORKER_PROCESS_ADD": "worker actor Add partition+send handling",
+    "WORKER_COALESCE_FLUSH": "coalesced BatchAdd flushes packed",
+    "WORKER_TABLE_SYNC_GET": "blocking table get_raw issue-to-reply",
+    "WORKER_TABLE_SYNC_ADD": "blocking table add_raw issue-to-ack",
+    # -- server actor --
+    "SERVER_PROCESS_GET": "server-side Get table op + reply",
+    "SERVER_PROCESS_ADD": "server-side Add apply + ack",
+    "SERVER_PROCESS_BATCH_ADD": "server-side coalesced batch apply",
+    # -- model / collective stalls --
+    "PS_GET_STALL": "trainer blocked on a parameter Get (prefetch miss)",
+    "MA_COMM_STALL": "model-average blocked on the collective",
+    # -- snapshotter --
+    "SNAPSHOT_CAPTURE": "consistent state cut under the table lock",
+    "SNAPSHOT_WRITE": "snapshot serialize+write off the lock",
+    # -- wire transport --
+    "tcp_serialize": "message -> wire frame serialize",
+    "tcp_send": "blocking socket send of one frame",
+    "tcp_recv": "socket read of one inbound frame body",
+    "tcp_deserialize": "wire frame -> message parse",
+    # -- client cache (tables/client_cache.py) --
+    "CLIENT_CACHE_HIT": "cache lookups served locally",
+    "CLIENT_CACHE_MISS": "cache lookups that crossed the wire",
+    "CLIENT_CACHE_JOIN": "gets joined onto an in-flight prefetch",
+    "CLIENT_CACHE_PREFETCH": "prefetch requests issued",
+    # -- hot-shard replication (runtime/replica.py) --
+    "REPLICA_HIT": "rows served from a replica store",
+    "REPLICA_MISS": "replicated rows a holder could not serve",
+    "REPLICA_REPAIR": "repair requests issued to row owners",
+    "REPLICA_STALE": "replica groups rejected below a RYW floor",
+    "REPLICA_SYNC": "write-through refreshes fanned out",
+    # -- per-destination dispatch queues (runtime/communicator.py) --
+    "DISPATCH_MS[d*]": "per-destination dispatch queue latency (ms)",
+    "DISPATCH_QUEUE_DEPTH[d*]": "per-destination queue depth at submit",
+    # -- observability export (runtime/metrics.py) --
+    "METRICS_REPORT": "per-rank metrics snapshots shipped",
+}
+
+#: Version stamp on serialized metrics snapshots
+#: (``metrics_snapshot()``): consumers reject a snapshot whose version
+#: they do not understand instead of mis-merging it.
+#: Family matching against the registry (trailing-``*`` entries) lives
+#: in ``tools/mvlint/metric_lint.py family_match`` — the one
+#: implementation, used by the lint that enforces this registry.
+METRICS_SNAPSHOT_VERSION = 1
 
 
 class Monitor:
@@ -96,10 +154,24 @@ class Dashboard:
 
     @classmethod
     def display(cls) -> str:
+        """Full registry report: monitors AND sample reservoirs, each
+        section sorted by name so successive dumps diff cleanly (dict
+        insertion order made the report depend on which code path ran
+        first)."""
         with cls._lock:
-            lines = [str(m) for m in cls._monitors.values()]
-        report = "\n".join(lines)
-        return report
+            lines = [str(m) for _, m in sorted(cls._monitors.items())]
+        with _samples_lock:
+            reservoirs = sorted(_samples.items())
+        for name, s in reservoirs:
+            snap = s.snapshot()
+            if snap.get("count"):
+                lines.append(
+                    f"[{name}] count = {snap['count']} "
+                    f"p50 = {snap.get('p50', 0.0):.3f} "
+                    f"p90 = {snap.get('p90', 0.0):.3f} "
+                    f"p99 = {snap.get('p99', 0.0):.3f} "
+                    f"max = {snap.get('max', 0.0):.3f}")
+        return "\n".join(lines)
 
     @classmethod
     def reset(cls) -> None:
@@ -115,7 +187,8 @@ class monitor:
     """
 
     def __init__(self, name: str, trace: bool = False):
-        self._monitor = Dashboard.get(name)
+        self._name = name
+        self._monitor: Optional[Monitor] = None
         self._trace_ctx = None
         if trace:
             import jax.profiler
@@ -124,11 +197,18 @@ class monitor:
     def __enter__(self) -> Monitor:
         if self._trace_ctx is not None:
             self._trace_ctx.__enter__()
+        # Re-resolved per entry, NOT cached at construction: a
+        # ``Dashboard.reset()`` (every bench phase does one) replaces
+        # the registry, and a long-lived ``monitor(...)`` instance
+        # caching its Monitor would keep writing to an unregistered
+        # orphan that no display()/snapshot ever sees.
+        self._monitor = Dashboard.get(self._name)
         self._monitor.begin()
         return self._monitor
 
     def __exit__(self, *exc) -> None:
-        self._monitor.end()
+        if self._monitor is not None:
+            self._monitor.end()
         if self._trace_ctx is not None:
             self._trace_ctx.__exit__(*exc)
         return None
@@ -163,15 +243,24 @@ class Samples:
     def count(self) -> int:
         return self._total
 
+    @staticmethod
+    def _nearest_rank(data: list, p: float) -> float:
+        """Nearest-rank percentile over sorted ``data``: the
+        ceil(p/100 * n)-th smallest value (1-indexed), so p50 of a
+        2-element window is the LOWER value and a 1-element window
+        answers every p with its only value."""
+        idx = max(math.ceil(len(data) * min(max(p, 0.0), 100.0)
+                            / 100.0), 1) - 1
+        return data[min(idx, len(data) - 1)]
+
     def percentile(self, p: float) -> float:
-        """The p-th percentile (0-100) of the retained window; 0.0 when
-        empty."""
+        """The p-th percentile (0-100, nearest-rank) of the retained
+        window; 0.0 when empty."""
         with self._lock:
             data = sorted(self._buf)
         if not data:
             return 0.0
-        idx = min(int(len(data) * p / 100.0), len(data) - 1)
-        return data[idx]
+        return self._nearest_rank(data, p)
 
     def snapshot(self) -> dict:
         """Bench-friendly summary: count + p50/p90/p99/max."""
@@ -180,12 +269,23 @@ class Samples:
             total = self._total
         if not data:
             return {"count": total}
+        return {"count": total,
+                "p50": self._nearest_rank(data, 50),
+                "p90": self._nearest_rank(data, 90),
+                "p99": self._nearest_rank(data, 99),
+                "max": data[-1]}
 
-        def pick(p):
-            return data[min(int(len(data) * p / 100.0), len(data) - 1)]
-
-        return {"count": total, "p50": pick(50), "p90": pick(90),
-                "p99": pick(99), "max": data[-1]}
+    def export_recent(self, limit: int = 256) -> List[float]:
+        """Up to ``limit`` of the most recent retained values, oldest
+        first — the raw window the controller merges cluster-wide
+        percentiles from (summary snapshots cannot be merged without
+        the underlying samples; docs/OBSERVABILITY.md)."""
+        with self._lock:
+            if len(self._buf) < self._cap or self._next == 0:
+                ordered = list(self._buf)
+            else:  # ring wrapped: oldest sits at _next
+                ordered = self._buf[self._next:] + self._buf[:self._next]
+        return ordered[-max(int(limit), 1):]
 
 
 _samples: Dict[str, Samples] = {}
@@ -205,6 +305,28 @@ def samples(name: str, cap: int = 8192) -> Samples:
 def reset_samples() -> None:
     with _samples_lock:
         _samples.clear()
+
+
+def metrics_snapshot(max_samples: int = 256) -> dict:
+    """Serialize the whole registry (monitors + sample reservoirs) into
+    a versioned plain dict — the per-rank payload of the
+    ``Control_Metrics`` export (runtime/metrics.py) and the local half
+    of every ``/metrics`` scrape. ``max_samples`` caps the raw window
+    shipped per reservoir (the controller merges these into cluster
+    percentiles)."""
+    with Dashboard._lock:
+        monitors = list(Dashboard._monitors.items())
+    with _samples_lock:
+        reservoirs = list(_samples.items())
+    return {
+        "v": METRICS_SNAPSHOT_VERSION,
+        "monitors": {name: {"count": m.count,
+                            "elapsed_ms": round(m.elapse, 3)}
+                     for name, m in monitors},
+        "samples": {name: {"count": s.count,
+                           "recent": s.export_recent(max_samples)}
+                    for name, s in reservoirs},
+    }
 
 
 def count(name: str, n: int = 1) -> None:

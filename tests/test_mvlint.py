@@ -82,18 +82,32 @@ class TestFixtures:
         assert "blocking call .recv" in messages
         assert result.per_pass_suppressed["lock-discipline"] == 1
 
+    def test_metric_name_seeded(self):
+        result = _fixture_result("bad_metrics.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "metric-name"]
+        assert len(found) == 3, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        # The typo diagnostic names the nearest real metric.
+        assert "did you mean 'SERVER_PROCESS_GET'" in messages
+        assert "DISPATCH_MS[q9]" in messages
+        assert "TOTALLY_MADE_UP_COUNTER" in messages
+        # The family instance and the str.count attribute call in the
+        # fixture stay silent; the pragma'd site counts as suppressed.
+        assert result.per_pass_suppressed["metric-name"] == 1
+
     def test_fixture_dir_fails_as_a_whole(self):
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 17
-        assert len(result.suppressed) == 4
+        assert len(result.violations) == 20
+        assert len(result.suppressed) == 5
 
 
 class TestCleanTree:
     def test_final_tree_is_clean(self):
         # The acceptance gate: the shipped tree has zero non-pragma'd
-        # violations across all four passes.
+        # violations across all five passes.
         result = run(("multiverso_tpu", "tests", "bench.py"), REPO_ROOT)
         assert not result.failed, \
             "\n".join(v.render() for v in result.violations)
@@ -102,6 +116,29 @@ class TestCleanTree:
         doc = parse_doc_slots(REPO_ROOT / "docs" / "WIRE_FORMAT.md")
         from multiverso_tpu.core.message import WIRE_SLOTS
         assert doc == WIRE_SLOTS
+
+    def test_doc_metric_table_matches_registry(self):
+        from tools.mvlint.metric_lint import (load_metric_names,
+                                             parse_doc_metrics)
+        doc = parse_doc_metrics(REPO_ROOT / "docs" / "OBSERVABILITY.md")
+        registry = load_metric_names(
+            REPO_ROOT / "multiverso_tpu" / "util" / "dashboard.py")
+        assert set(doc) == set(registry)
+
+    def test_metric_doc_drift_is_a_violation(self, tmp_path):
+        from tools.mvlint.metric_lint import MetricNameLint
+        drifted = tmp_path / "OBSERVABILITY.md"
+        drifted.write_text(
+            "| `SERVER_PROCESS_GET` | monitor | fine |\n"
+            "| `GHOST_METRIC` | counter | stale doc row |\n")
+        lint = MetricNameLint({"SERVER_PROCESS_GET": "x",
+                               "NEVER_DOCUMENTED": "y"}, drifted)
+        module = ModuleInfo(FIXTURES / "bad_flags.py", REPO_ROOT)
+        found = list(lint.check(module))
+        messages = "\n".join(v.message for v in found)
+        assert "GHOST_METRIC" in messages          # doc-only row
+        assert "NEVER_DOCUMENTED" in messages      # registry-only name
+        assert len(found) == 2
 
     def test_doc_drift_is_a_violation(self, tmp_path):
         drifted = tmp_path / "WIRE_FORMAT.md"

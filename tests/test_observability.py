@@ -1,0 +1,567 @@
+"""Observability layer tests (docs/OBSERVABILITY.md).
+
+Distributed request tracing (util/tracing.py + the TRACE_SLOT wire
+plumbing), the metrics export/aggregation pipeline
+(runtime/metrics.py), the HTTP scrape surface (io/metrics_http.py),
+and the PR's acceptance integration: a 3-process TCP PS cluster
+(1 worker + 2 servers) whose merged /trace.json shows one Get's spans
+crossing rank boundaries under one trace id, and whose /metrics
+scrape exposes cluster-aggregated SERVER_PROCESS_GET counts equal to
+the sum of the per-rank dumps.
+"""
+
+import json
+import re
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.blob import Blob
+from multiverso_tpu.core.message import (HEADER_SIZE, Message, MsgType,
+                                         TRACE_SLOT, WIRE_SLOTS,
+                                         pack_add_batch, stamp_trace,
+                                         trace_of)
+from multiverso_tpu.io.metrics_http import (MetricsHttpServer,
+                                            json_route,
+                                            prometheus_route)
+from multiverso_tpu.runtime.metrics import (ClusterMetrics,
+                                            parse_report,
+                                            split_family)
+from multiverso_tpu.runtime.tcp import _serialize
+from multiverso_tpu.util import tracing
+from multiverso_tpu.util.configure import set_flag
+from multiverso_tpu.util.dashboard import (Dashboard, metrics_snapshot,
+                                           reset_samples, samples)
+
+from test_net_integration import run_cluster, write_machine_file
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    tracing.reset()
+    Dashboard.reset()
+    reset_samples()
+    yield
+    tracing.reset()
+    Dashboard.reset()
+    reset_samples()
+
+
+# ---------------------------------------------------------------------------
+# trace ids + sampling
+# ---------------------------------------------------------------------------
+
+class TestTraceIds:
+    def test_default_off_draws_nothing(self):
+        assert tracing.new_trace(rank=0) == 0
+        assert tracing.new_trace(rank=3) == 0
+        assert tracing.snapshot_events() == []
+
+    def test_full_sampling_ids_unique_and_rank_tagged(self):
+        set_flag("trace_sample_rate", 1.0)
+        ids = [tracing.new_trace(rank=5) for _ in range(100)]
+        assert all(i > 0 for i in ids)
+        assert len(set(ids)) == 100
+        assert all(tracing.trace_rank(i) == 5 for i in ids)
+        assert all(i < 2 ** 31 for i in ids)  # rides an int32 slot
+
+    def test_partial_sampling_is_a_subset(self):
+        set_flag("trace_sample_rate", 0.3)
+        drawn = sum(1 for _ in range(500)
+                    if tracing.new_trace(rank=0))
+        assert 0 < drawn < 500  # statistically certain at 0.3/500
+
+
+# ---------------------------------------------------------------------------
+# span recording + ring bound + watchdog
+# ---------------------------------------------------------------------------
+
+class TestSpanRecording:
+    def test_span_and_event_record(self):
+        with tracing.span(7, "table_op:get", rank=1,
+                          args={"table": 0}):
+            time.sleep(0.001)
+        tracing.event(7, "waiter_notify", rank=1)
+        events = tracing.snapshot_events()
+        assert [e["name"] for e in events] == ["table_op:get",
+                                              "waiter_notify"]
+        x, i = events
+        assert x["ph"] == "X" and x["dur"] >= 1_000_000  # >= 1ms in ns
+        assert x["args"] == {"table": 0}
+        assert i["ph"] == "i"
+        assert all(e["trace"] == 7 and e["rank"] == 1 for e in events)
+
+    def test_untraced_span_is_inert_and_shared(self):
+        a = tracing.span(0, "x", rank=0)
+        b = tracing.span(0, "y", rank=0)
+        assert a is b  # the shared null singleton: no per-call alloc
+        with a:
+            pass
+        tracing.event(0, "z", rank=0)
+        assert tracing.snapshot_events() == []
+
+    def test_ring_buffer_bounds_memory(self):
+        set_flag("trace_buffer", 32)
+        for k in range(100):
+            tracing.event(1, f"e{k}", rank=0)
+        events = tracing.snapshot_events()
+        assert len(events) == 32
+        # Newest retained: the last 32 of the 100.
+        assert events[0]["name"] == "e68"
+        assert events[-1]["name"] == "e99"
+
+    def test_drain_since_is_incremental(self):
+        tracing.event(1, "a", rank=0)
+        first = tracing.drain_since(0)
+        assert [e["name"] for e in first] == ["a"]
+        tracing.event(1, "b", rank=0)
+        fresh = tracing.drain_since(max(e["seq"] for e in first))
+        assert [e["name"] for e in fresh] == ["b"]
+
+    def test_slow_watchdog_logs_timeline(self, capsys):
+        set_flag("trace_slow_ms", 1.0)
+        t0 = tracing.now_ns()
+        tracing.event(9, "server_mailbox_enqueue", rank=1)
+        time.sleep(0.01)
+        tracing.end_root(9, "worker_issue:Request_Get[t0]", 0, t0)
+        err = capsys.readouterr().err
+        assert "slow request" in err
+        assert "worker_issue:Request_Get[t0]" in err
+        assert "server_mailbox_enqueue" in err
+
+    def test_fast_root_stays_quiet(self, capsys):
+        set_flag("trace_slow_ms", 10_000.0)
+        tracing.end_root(9, "worker_issue:Request_Get[t0]", 0,
+                         tracing.now_ns())
+        assert "slow request" not in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export schema
+# ---------------------------------------------------------------------------
+
+def validate_chrome_trace(doc):
+    """Schema check for the merged Chrome-trace JSON (the acceptance
+    test loads /trace.json through this)."""
+    assert isinstance(doc, dict)
+    assert isinstance(doc["traceEvents"], list)
+    for e in doc["traceEvents"]:
+        assert isinstance(e["name"], str) and e["name"]
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], str)
+        assert isinstance(e["args"]["trace"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    return doc["traceEvents"]
+
+
+class TestChromeExport:
+    def test_export_schema_and_merge(self):
+        with tracing.span(3, "tcp_send", rank=0):
+            pass
+        rank0 = tracing.snapshot_events()
+        rank1 = [{"trace": 3, "name": "server_process_get", "ph": "X",
+                  "rank": 1, "ts": tracing.now_ns(), "dur": 500,
+                  "thread": "mv-server-r1", "seq": 1}]
+        doc = tracing.chrome_trace([rank0, rank1])
+        events = validate_chrome_trace(doc)
+        assert {e["pid"] for e in events} == {0, 1}
+        assert {e["args"]["trace"] for e in events} == {3}
+        # ns -> us conversion
+        assert events[0]["ts"] == pytest.approx(
+            min(rank0[0]["ts"], rank1[0]["ts"]) / 1e3)
+
+
+# ---------------------------------------------------------------------------
+# wire: TRACE_SLOT plumbing + byte identity at sample rate 0
+# ---------------------------------------------------------------------------
+
+def _serialize_9int(msg):
+    """What the pre-trace (9-int header) build put on the wire — the
+    reference layout the byte-identity acceptance compares against."""
+    blobs = [b.wire_bytes().tobytes() for b in msg.data]
+    legacy = msg.header[:9]  # mvlint: ignore[wire-slot] - the legacy
+    # 9-int layout is exactly what this helper reconstructs
+    parts = [struct.pack("<9i", *[int(v) for v in legacy]),
+             struct.pack("<I", len(blobs))]
+    parts += [struct.pack("<Q", len(b)) for b in blobs]
+    parts += blobs
+    body = b"".join(parts)
+    return struct.pack("<Q", len(body)) + body
+
+
+class TestWirePlumbing:
+    def test_trace_slot_registered(self):
+        assert WIRE_SLOTS["TRACE_SLOT"] == TRACE_SLOT == 9
+        assert HEADER_SIZE == 10
+
+    def test_reply_carries_request_trace(self):
+        msg = Message(src=0, dst=1, msg_type=MsgType.Request_Get,
+                      table_id=2, msg_id=3)
+        stamp_trace(msg, 1234)
+        reply = msg.create_reply_message()
+        assert trace_of(reply) == 1234
+        untraced = Message(src=0, dst=1,
+                           msg_type=MsgType.Request_Get)
+        assert trace_of(untraced.create_reply_message()) == 0
+
+    def test_batch_inherits_first_sampled_sub(self):
+        subs = []
+        for k in range(3):
+            sub = Message(src=0, dst=1, msg_type=MsgType.Request_Add,
+                          table_id=k, msg_id=k)
+            sub.push(Blob(np.ones(2, np.float32)))
+            subs.append(sub)
+        stamp_trace(subs[1], 77)
+        batch = pack_add_batch(subs)
+        assert trace_of(batch) == 77
+        assert trace_of(pack_add_batch([subs[0], subs[2]])) == 0
+
+    def test_untraced_wire_bytes_identical_modulo_header_bump(self):
+        """Acceptance: with -trace_sample_rate=0 (default) the wire
+        bytes of a Get/Add exchange are byte-identical to a pre-trace
+        build everywhere except the declared header-length bump — i.e.
+        the frame differs ONLY by four zero bytes of header slot 9 and
+        the total-length prefix that grows with them."""
+        for msg_type in (MsgType.Request_Get, MsgType.Request_Add):
+            msg = Message(src=0, dst=1, msg_type=msg_type,
+                          table_id=2, msg_id=3)
+            msg.push(Blob(np.arange(6, dtype=np.int32)
+                          .view(np.uint8)))
+            msg.push(Blob(np.linspace(0, 1, 5, dtype=np.float32)))
+            frame = _serialize(msg)
+            old = _serialize_9int(msg)
+            # New frame: 4 extra bytes total, all in the header.
+            (total,) = struct.unpack_from("<Q", frame, 0)
+            (old_total,) = struct.unpack_from("<Q", old, 0)
+            assert total == old_total + 4
+            header = struct.unpack_from(f"<{HEADER_SIZE}i", frame, 8)
+            assert header[TRACE_SLOT] == 0
+            # Splicing the 10th header int out reproduces the old
+            # frame exactly, byte for byte.
+            spliced = struct.pack("<Q", old_total) \
+                + frame[8:8 + 9 * 4] + frame[8 + 10 * 4:]
+            assert spliced == old
+
+    def test_sampled_trace_id_survives_the_frame(self):
+        from multiverso_tpu.runtime.tcp import _deserialize
+        msg = Message(src=0, dst=1, msg_type=MsgType.Request_Get)
+        msg.push(Blob(np.ones(3, np.float32)))
+        stamp_trace(msg, 4242)
+        frame = _serialize(msg)
+        out = _deserialize(frame[8:])
+        assert trace_of(out) == 4242
+
+
+# ---------------------------------------------------------------------------
+# metrics snapshot + cluster aggregation + prometheus rendering
+# ---------------------------------------------------------------------------
+
+PROM_LINE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    r"(\{[a-zA-Z0-9_]+=\"[^\"]*\""        # first label
+    r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})?"   # more labels
+    r" -?[0-9.eE+-]+(inf)?$")             # value
+
+
+def validate_prometheus(text):
+    """Line-level validation of the text exposition format; returns
+    {(metric, frozenset(labels)): float value}."""
+    series = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ",
+                            line), line
+            continue
+        assert PROM_LINE_RE.match(line), f"bad exposition line: {line}"
+        name_labels, value = line.rsplit(" ", 1)
+        name, _, labels = name_labels.partition("{")
+        labels = labels.rstrip("}")
+        key = (name, frozenset(labels.split(",")) if labels
+               else frozenset())
+        series[key] = float(value)
+    return series
+
+
+def _fake_report(rank, gets, window):
+    return {"v": 1, "rank": rank,
+            "monitors": {"SERVER_PROCESS_GET":
+                         {"count": gets, "elapsed_ms": gets * 1.5}},
+            "samples": {"DISPATCH_MS[d1]":
+                        {"count": len(window), "recent": window}},
+            "trace_events": [
+                {"trace": 5, "name": "server_process_get", "ph": "X",
+                 "rank": rank, "ts": 1000, "dur": 10, "seq": rank}]}
+
+
+class TestClusterMetrics:
+    def test_snapshot_is_versioned_and_complete(self):
+        Dashboard.get("SERVER_PROCESS_GET").add(2.0)
+        samples("DISPATCH_MS[d0]").add(1.25)
+        snap = metrics_snapshot()
+        assert snap["v"] == 1
+        assert snap["monitors"]["SERVER_PROCESS_GET"]["count"] == 1
+        assert snap["samples"]["DISPATCH_MS[d0]"]["recent"] == [1.25]
+
+    def test_parse_report_rejects_foreign_versions(self):
+        msg = Message(src=1, dst=0, msg_type=MsgType.Control_Metrics)
+        msg.push(Blob(np.frombuffer(
+            json.dumps({"v": 99, "rank": 1}).encode(),
+            np.uint8).copy()))
+        assert parse_report(msg) is None
+        bad = Message(src=1, dst=0, msg_type=MsgType.Control_Metrics)
+        bad.push(Blob(np.frombuffer(b"not json", np.uint8).copy()))
+        assert parse_report(bad) is None
+        assert parse_report(Message()) is None
+
+    def test_cluster_sum_and_merged_percentiles(self):
+        cm = ClusterMetrics()
+        cm.ingest(_fake_report(1, 30, [1.0, 2.0]))
+        cm.ingest(_fake_report(2, 12, [100.0, 200.0]))
+        cm.ingest(_fake_report(1, 31, [1.0, 2.0]))  # newest per rank wins
+        view = cm.cluster_view()
+        agg = view["monitors_sum"]["SERVER_PROCESS_GET"]
+        assert agg["count"] == 31 + 12
+        merged = view["samples_merged"]["DISPATCH_MS[d1]"]
+        assert merged["count"] == 4
+        assert merged["max"] == 200.0
+        assert merged["p50"] == 2.0  # nearest-rank over the union
+        assert view["ranks"][2]["monitors"][
+            "SERVER_PROCESS_GET"]["count"] == 12
+
+    def test_prometheus_text_is_valid_and_sums(self):
+        cm = ClusterMetrics()
+        cm.ingest(_fake_report(1, 30, [1.0]))
+        cm.ingest(_fake_report(2, 12, [3.0]))
+        series = validate_prometheus(cm.prometheus_text())
+        name = 'name="SERVER_PROCESS_GET"'
+        per_rank = [v for (metric, labels), v in series.items()
+                    if metric == "mv_monitor_count_total"
+                    and name in labels]
+        assert sorted(per_rank) == [12.0, 30.0]
+        total = series[("mv_cluster_monitor_count_total",
+                        frozenset([name]))]
+        assert total == sum(per_rank) == 42.0
+        q99 = series[("mv_cluster_samples",
+                      frozenset(['name="DISPATCH_MS"', 'key="d1"',
+                                 'quantile="0.99"']))]
+        assert q99 == 3.0
+
+    def test_split_family(self):
+        assert split_family("DISPATCH_MS[d1]") == ("DISPATCH_MS", "d1")
+        assert split_family("SERVER_PROCESS_GET") \
+            == ("SERVER_PROCESS_GET", "")
+
+    def test_merged_trace_feeds_chrome_export(self):
+        cm = ClusterMetrics()
+        cm.ingest(_fake_report(1, 1, []))
+        cm.ingest(_fake_report(2, 1, []))
+        events = validate_chrome_trace(cm.chrome_trace_json())
+        assert {e["pid"] for e in events} == {1, 2}
+
+
+# ---------------------------------------------------------------------------
+# HTTP scrape surface
+# ---------------------------------------------------------------------------
+
+class TestMetricsHttp:
+    def test_routes_content_and_404(self):
+        server = MetricsHttpServer(0, {
+            "/metrics": prometheus_route(lambda: "mv_up 1\n"),
+            "/trace.json": json_route(
+                lambda: {"traceEvents": []}),
+        }, host="127.0.0.1")
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(f"{base}/metrics",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                assert resp.read() == b"mv_up 1\n"
+            with urllib.request.urlopen(f"{base}/trace.json",
+                                        timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "application/json")
+                assert json.loads(resp.read()) == {"traceEvents": []}
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/nope", timeout=10)
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+    def test_renderer_failure_is_a_500_not_a_crash(self):
+        def boom():
+            raise RuntimeError("broken renderer")
+        server = MetricsHttpServer(0, {
+            "/metrics": prometheus_route(boom)}, host="127.0.0.1")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics",
+                    timeout=10)
+            assert exc.value.code == 500
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-process end to end: root span envelops the server-side spans
+# ---------------------------------------------------------------------------
+
+class TestInProcessEndToEnd:
+    def test_sampled_get_produces_nested_spans(self):
+        mv.init(["-trace_sample_rate=1.0"])
+        try:
+            table = mv.create_matrix_table(32, 4)
+            table.add_rows(np.arange(8, dtype=np.int32),
+                           np.ones((8, 4), np.float32))
+            table.get_rows(np.arange(8, dtype=np.int32))
+        finally:
+            mv.shutdown()
+        events = tracing.snapshot_events()
+        roots = [e for e in events
+                 if e["name"].startswith("worker_issue:Request_Get")]
+        assert roots, [e["name"] for e in events]
+        root = roots[-1]
+        nested = [e for e in events
+                  if e["trace"] == root["trace"]
+                  and e["name"] == "table_op:get"]
+        assert nested, [e["name"] for e in events]
+        for inner in nested:
+            assert root["ts"] <= inner["ts"]
+            assert inner["ts"] + inner["dur"] \
+                <= root["ts"] + root["dur"]
+
+    def test_default_rate_records_nothing(self):
+        mv.init([])
+        try:
+            table = mv.create_matrix_table(16, 4)
+            table.get_rows(np.arange(4, dtype=np.int32))
+        finally:
+            mv.shutdown()
+        assert tracing.snapshot_events() == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 3-process TCP cluster (1 worker + 2 servers)
+# ---------------------------------------------------------------------------
+
+def test_three_process_trace_and_metrics_scrape(tmp_path):
+    """The PR's acceptance integration: full sampling + metrics export
+    over a real 3-process TCP cluster. The worker writes the /metrics
+    and /trace.json scrapes to files this process then validates:
+    (a) at least one Get's spans cross rank boundaries and nest under
+    one trace id; (b) the Prometheus scrape is valid text exposition
+    and its cluster-aggregated SERVER_PROCESS_GET equals the sum of
+    the per-rank dumps the servers print."""
+    from multiverso_tpu.util.net_util import free_listen_port
+    n = 3
+    mf, _ = write_machine_file(tmp_path, n)
+    mport = free_listen_port()
+    trace_path = tmp_path / "trace.json"
+    prom_path = tmp_path / "metrics.txt"
+    common = f"""
+role = "worker" if rank == 0 else "server"
+mv.init(["-machine_file={mf}", "-rank=" + str(rank),
+         "-ps_role=" + role, "-trace_sample_rate=1.0",
+         "-metrics_interval_s=0.2", "-metrics_port={mport}"])
+from multiverso_tpu.runtime.zoo import current_zoo
+from multiverso_tpu.util.dashboard import Dashboard
+zoo = current_zoo()
+table = mv.create_matrix_table(16, 4)
+"""
+    worker = common + f"""
+import time, urllib.request
+ids = np.arange(16, dtype=np.int32)   # spans BOTH server shards
+table.add_rows(ids, np.ones((16, 4), np.float32))
+for _ in range(20):
+    out = table.get_rows(ids)
+assert out.shape == (16, 4) and out.sum() > 0
+mv.barrier()            # traffic done cluster-wide
+zoo.metrics_flush()     # final local report
+mv.barrier()            # every rank flushed
+base = "http://127.0.0.1:{mport}"
+# Remote reports ride async writer threads: scrape until the cluster
+# SERVER_PROCESS_GET stabilizes across two polls (bounded).
+prev = None
+for _ in range(50):
+    prom = urllib.request.urlopen(base + "/metrics",
+                                  timeout=10).read()
+    import re as _re
+    m = _re.search(rb'mv_cluster_monitor_count_total'
+                   rb'\\{{name="SERVER_PROCESS_GET"\\}} (\\d+)', prom)
+    cur = m.group(1) if m else None
+    if cur is not None and cur == prev:
+        break
+    prev = cur
+    time.sleep(0.3)
+trace = urllib.request.urlopen(base + "/trace.json",
+                               timeout=10).read()
+open(r"{prom_path}", "wb").write(prom)
+open(r"{trace_path}", "wb").write(trace)
+mv.barrier()            # keep the scrape inside the cluster lifetime
+mv.shutdown()
+print("WORKER_OK")
+"""
+    server = common + """
+mv.barrier()            # traffic done
+zoo.metrics_flush()
+mv.barrier()
+print("SERVER_GET_COUNT=%d"
+      % Dashboard.get("SERVER_PROCESS_GET").count)
+mv.barrier()            # wait out the worker's scrape
+mv.shutdown()
+print("SERVER_OK")
+"""
+    outs = run_cluster([worker, server, server], timeout=300)
+    assert "WORKER_OK" in outs[0]
+    per_rank = [int(m.group(1)) for o in outs[1:]
+                for m in [re.search(r"SERVER_GET_COUNT=(\d+)", o)]
+                if m]
+    assert len(per_rank) == 2 and all(c > 0 for c in per_rank), outs
+
+    # (b) valid Prometheus exposition; cluster aggregate == sum of the
+    # per-rank dumps, and the per-rank series match them too.
+    series = validate_prometheus(prom_path.read_text())
+    name = 'name="SERVER_PROCESS_GET"'
+    total = series[("mv_cluster_monitor_count_total",
+                    frozenset([name]))]
+    assert total == sum(per_rank)
+    scraped_ranks = sorted(
+        v for (metric, labels), v in series.items()
+        if metric == "mv_monitor_count_total" and name in labels
+        and 'rank="0"' not in labels)
+    assert scraped_ranks == sorted(float(c) for c in per_rank)
+
+    # (a) merged chrome trace: a Get whose spans cross rank boundaries
+    # and nest under one trace id (worker issue envelops the server
+    # span recorded on ANOTHER rank).
+    events = validate_chrome_trace(
+        json.loads(trace_path.read_text()))
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e["args"]["trace"], []).append(e)
+    nested_cross_rank = 0
+    for tid, group in by_trace.items():
+        roots = [e for e in group
+                 if e["name"].startswith("worker_issue:Request_Get")]
+        if not roots:
+            continue
+        root = roots[0]
+        for e in group:
+            if (e["pid"] != root["pid"]
+                    and e["name"] == "server_process_get"
+                    and e["ts"] >= root["ts"]
+                    and e["ts"] + e["dur"]
+                    <= root["ts"] + root["dur"]):
+                nested_cross_rank += 1
+    assert nested_cross_rank > 0, (
+        f"no cross-rank nested Get trace among {len(by_trace)} traces")

@@ -1,17 +1,20 @@
 """mvlint: project-invariant static analysis for the actor/PS runtime.
 
-Four passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+Five passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 (see each module's docstring for the precise rules):
 
 * ``flag-lint`` — every flag access names a canonical registered flag
   with the canonical default (``util/configure.py CANONICAL_FLAGS``).
-* ``wire-slot`` — reserved header slots 5-7 are accessed by registered
+* ``wire-slot`` — reserved header slots 5-9 are accessed by registered
   name only (``core/message.py WIRE_SLOTS``), and the registry matches
   the slot table in ``docs/WIRE_FORMAT.md``.
 * ``device-dispatch`` — multi-zoo-reachable eager dispatch sits inside
   a ``device_lock.guard()``-class context (the PR-1/PR-4 XLA wedge).
 * ``lock-discipline`` — registered locks are ``with``-scoped and never
   lexically wrap a blocking call.
+* ``metric-name`` — every ``monitor``/``samples``/``count`` literal
+  names a canonical metric (``util/dashboard.py METRIC_NAMES``,
+  cross-checked against the table in ``docs/OBSERVABILITY.md``).
 
 Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
 (``--baseline`` prints per-pass counts without failing). The runtime
@@ -29,6 +32,7 @@ from .device_dispatch_lint import DeviceDispatchLint
 from .flag_lint import FlagLint, load_canonical_flags
 from .framework import LintPass, RunResult, Violation, run_passes
 from .lock_lint import LockDisciplineLint
+from .metric_lint import MetricNameLint, load_metric_names
 from .wire_slot_lint import WireSlotLint, load_wire_slots
 
 #: Repo root = two levels above this package (tools/mvlint/__init__.py).
@@ -42,11 +46,14 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         root / "multiverso_tpu" / "util" / "configure.py")
     slots = load_wire_slots(
         root / "multiverso_tpu" / "core" / "message.py")
+    metrics = load_metric_names(
+        root / "multiverso_tpu" / "util" / "dashboard.py")
     return [
         FlagLint(canonical),
         WireSlotLint(slots, root / "docs" / "WIRE_FORMAT.md"),
         DeviceDispatchLint(),
         LockDisciplineLint(),
+        MetricNameLint(metrics, root / "docs" / "OBSERVABILITY.md"),
     ]
 
 

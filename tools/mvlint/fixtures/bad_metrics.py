@@ -1,0 +1,43 @@
+"""Seeded metric-name violations (mvlint self-check fixture).
+
+Every block below must keep firing the ``metric-name`` pass — pinned
+counts live in tests/test_mvlint.py. The registry the pass checks
+against is ``multiverso_tpu/util/dashboard.py METRIC_NAMES``.
+"""
+
+from multiverso_tpu.util.dashboard import count, monitor, samples
+
+
+def unknown_monitor():
+    # Violation: typo'd monitor name (suggestion should name the real
+    # SERVER_PROCESS_GET).
+    with monitor("SERVER_PROCES_GET"):
+        pass
+
+
+def unknown_samples_family():
+    # Violation: DISPATCH_MS[d*] covers d-suffixed instances only —
+    # a q-keyed family member is not registered.
+    samples("DISPATCH_MS[q9]").add(1.0)
+
+
+def unknown_counter():
+    # Violation: bare count() with an unregistered literal.
+    count("TOTALLY_MADE_UP_COUNTER")
+
+
+def family_instance_is_fine():
+    # NOT a violation: covered by the DISPATCH_MS[d*] family entry.
+    samples("DISPATCH_MS[d3]").add(1.0)
+
+
+def method_count_is_fine(text: str) -> int:
+    # NOT a violation: attribute call — str.count, not the dashboard
+    # counter.
+    return text.count("SERVER_PROCES_GET")
+
+
+def pragma_suppressed():
+    # Annotated exception: counted as suppressed, not as a violation.
+    with monitor("FIXTURE_ONLY_REGION"):  # mvlint: ignore[metric-name]
+        pass
