@@ -1620,6 +1620,220 @@ def run_observability() -> dict:
     return out
 
 
+def run_serving() -> dict:
+    """Serving-tier phase (docs/SERVING.md): Zipf(1.6) HTTP QPS
+    against the online serving frontend while a trainer thread
+    concurrently pushes Adds into the same table — the ROADMAP item 4
+    'training + serving system' proof. Two arms over identical
+    request streams:
+
+    - NORMAL: default admission knobs; reports p50/p99 latency, QPS,
+      rows/s, cache hit rate (request-level and row-granular, overall
+      + on the Zipf head), shed rate (expected ~0), and
+      staleness-bound violations (must be 0).
+    - OVERLOAD: the per-endpoint in-flight cap is dropped to 1 and
+      twice the client threads hammer with no backoff; the frontend
+      must shed (429 + Retry-After on every shed) while the p99 of
+      ACCEPTED requests stays bounded — load shedding IS the latency
+      defense, so p99 must not collapse.
+
+    Clients hold keep-alive connections (http.client over the
+    frontend's HTTP/1.1) — the inference-client shape, and without it
+    the TCP handshake per request IS the benchmark. Acceptance: head
+    row-granular cache coverage >= 0.9 (the trainer deliberately
+    dirties the head, so request-level all-rows-fresh hits are
+    reported but not gated), every shed carries Retry-After, zero
+    staleness violations, and overload p99 of accepted requests <=
+    max(10x normal p99, 250 ms)."""
+    import http.client
+    import json
+    import threading
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.serving.frontend import ServingFrontend
+    from multiverso_tpu.util.configure import set_flag
+
+    num_row, num_col = 4096, 32
+    staleness, head_n, per_req = 16, 16, 6
+    out = {"num_row": num_row, "num_col": num_col,
+           "max_get_staleness": staleness, "zipf_a": 1.6,
+           "head_rows": head_n, "ids_per_request": per_req}
+
+    mv.init([])
+    set_flag("max_get_staleness", staleness)
+    try:
+        table = mv.create_matrix_table(num_row, num_col)
+        rng = np.random.default_rng(5)
+        table.add(rng.standard_normal((num_row, num_col))
+                  .astype(np.float32))
+        frontend = ServingFrontend(mv.current_zoo(), port=0,
+                                   host="127.0.0.1")
+        frontend.register_table("emb", table)
+
+        stop = threading.Event()
+        adds = [0]
+
+        def trainer():
+            """Concurrent write load: Zipf-shaped Adds (the word2vec
+            delta pattern — head-heavy, so the trainer keeps dirtying
+            exactly the rows users read most) with the idiomatic
+            recovery prefetch of the dirtied rows
+            (docs/CLIENT_CACHE.md)."""
+            trng = np.random.default_rng(17)
+            while not stop.is_set():
+                ids = np.unique((trng.zipf(1.6, 16) - 1) % num_row) \
+                    .astype(np.int32)
+                table.add_rows(ids, np.full((ids.size, num_col), 1e-4,
+                                            np.float32))
+                table.prefetch_rows_async(ids)
+                adds[0] += 1
+                time.sleep(0.02)
+
+        def _new_arm():
+            return {"lock": threading.Lock(), "lat": [], "rows": 0,
+                    "hits": 0, "misses": 0, "rows_req": 0,
+                    "rows_cached": 0, "head_total": 0, "head_hits": 0,
+                    "head_rows_req": 0, "head_rows_cached": 0,
+                    "shed": 0, "shed_no_retry_after": 0,
+                    "staleness_violations": 0}
+
+        def client(seed, n, arm):
+            """One keep-alive inference client: Zipf(1.6) row reads,
+            sheds counted (and their Retry-After checked), accepted
+            responses checked for the staleness invariant."""
+            crng = np.random.default_rng(seed)
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              frontend.port,
+                                              timeout=60)
+            try:
+                for _ in range(n):
+                    ids = np.unique((crng.zipf(1.6, per_req) - 1)
+                                    % num_row)
+                    path = ("/v1/tables/emb/rows?ids="
+                            + ",".join(str(i) for i in ids))
+                    t0 = time.perf_counter()
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    body = resp.read()  # always: keep-alive reuse
+                    if resp.status in (429, 503):
+                        with arm["lock"]:
+                            arm["shed"] += 1
+                            if resp.getheader("Retry-After") is None:
+                                arm["shed_no_retry_after"] += 1
+                        continue
+                    assert resp.status == 200, (resp.status, body)
+                    doc = json.loads(body)
+                    lat_ms = (time.perf_counter() - t0) * 1e3
+                    head = bool(ids.max() < head_n)
+                    with arm["lock"]:
+                        arm["lat"].append(lat_ms)
+                        arm["rows"] += int(ids.size)
+                        arm["hits" if doc["cache_hit"]
+                            else "misses"] += 1
+                        arm["rows_req"] += doc["rows_requested"]
+                        arm["rows_cached"] += doc["rows_cached"]
+                        if head:
+                            arm["head_total"] += 1
+                            arm["head_hits"] += int(doc["cache_hit"])
+                            arm["head_rows_req"] += \
+                                doc["rows_requested"]
+                            arm["head_rows_cached"] += \
+                                doc["rows_cached"]
+                        if doc["max_staleness"] > \
+                                doc["staleness_bound"]:
+                            arm["staleness_violations"] += 1
+            finally:
+                conn.close()
+
+        def run_arm(n_threads, n_per, seed0):
+            arm = _new_arm()
+            threads = [threading.Thread(target=client,
+                                        args=(seed0 + i, n_per, arm))
+                       for i in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            lat = sorted(arm["lat"])
+
+            def pick(p):
+                return round(lat[min(int(len(lat) * p / 100),
+                                     len(lat) - 1)], 3) if lat else None
+            served = arm["hits"] + arm["misses"]
+            total = served + arm["shed"]
+            return {
+                "requests": total, "served": served,
+                "elapsed_s": round(elapsed, 3),
+                "qps": round(total / elapsed, 1),
+                "rows_per_s": round(arm["rows"] / elapsed, 1),
+                "p50_ms": pick(50), "p99_ms": pick(99),
+                "hit_rate": round(arm["hits"] / max(served, 1), 4),
+                "row_hit_rate": round(
+                    arm["rows_cached"] / max(arm["rows_req"], 1), 4),
+                "head_requests": arm["head_total"],
+                "head_hit_rate": round(
+                    arm["head_hits"] / max(arm["head_total"], 1), 4),
+                "head_row_hit_rate": round(
+                    arm["head_rows_cached"]
+                    / max(arm["head_rows_req"], 1), 4),
+                "shed": arm["shed"],
+                "shed_rate": round(arm["shed"] / max(total, 1), 4),
+                "shed_without_retry_after":
+                    arm["shed_no_retry_after"],
+                "staleness_violations": arm["staleness_violations"]}
+
+        trainer_thread = threading.Thread(target=trainer, daemon=True)
+        trainer_thread.start()
+        # Warm: gather-bucket compiles out of the timed window, cache
+        # populated to steady state (the state a serving replica runs
+        # in; cold-start is the client_cache phase's story).
+        for k in (4, 8, 16, 32, 64):
+            table.get_rows(np.linspace(0, num_row - 1, k)
+                           .astype(np.int32))
+        client(99, 120, _new_arm())
+
+        normal = run_arm(n_threads=3, n_per=200, seed0=100)
+        # Deliberate overload: one admitted request at a time, twice
+        # the clients, zero client backoff. Restore whatever cap the
+        # controller actually ran with (flag-sourced — a hand-copied
+        # constant here would drift from the canonical default).
+        prior_inflight = frontend.admission.stats()["max_inflight"]
+        frontend.admission.configure(max_inflight=1)
+        overload = run_arm(n_threads=6, n_per=100, seed0=200)
+        frontend.admission.configure(max_inflight=prior_inflight)
+        stop.set()
+        trainer_thread.join(timeout=10)
+        out["adds_during_run"] = adds[0]
+        out["admission"] = frontend.admission.stats()
+        drain_t0 = time.perf_counter()
+        frontend.stop()
+        out["drain_s"] = round(time.perf_counter() - drain_t0, 3)
+    finally:
+        set_flag("max_get_staleness", 0)  # phase-local (see
+        # run_client_cache: flag state survives shutdown/init cycles)
+        mv.shutdown()
+
+    p99_bound_ms = max(10 * (normal["p99_ms"] or 0.0), 250.0)
+    out.update(
+        normal=normal, overload=overload,
+        accept_head_hit_rate_ge_090=bool(
+            normal["head_row_hit_rate"] >= 0.9),
+        accept_overload_sheds=bool(overload["shed"] > 0),
+        accept_sheds_carry_retry_after=bool(
+            overload["shed_without_retry_after"] == 0
+            and normal["shed_without_retry_after"] == 0),
+        accept_zero_staleness_violations=bool(
+            normal["staleness_violations"] == 0
+            and overload["staleness_violations"] == 0),
+        overload_p99_bound_ms=round(p99_bound_ms, 3),
+        accept_overload_p99_accepted_bounded=bool(
+            overload["p99_ms"] is not None
+            and overload["p99_ms"] <= p99_bound_ms))
+    return out
+
+
 def matrix_bandwidth() -> dict:
     import jax.numpy as jnp
 
@@ -2189,6 +2403,10 @@ def main() -> None:
     obs = result.run("observability", run_observability)
     if obs:
         result.merge(observability=obs)
+
+    serving = result.run("serving", run_serving)
+    if serving:
+        result.merge(serving=serving)
 
     matrix = result.run("matrix_bandwidth", matrix_bandwidth)
     if matrix:

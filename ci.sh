@@ -69,6 +69,17 @@ echo "== obs subset (tracing / metrics export / scrape surface) =="
 # docs/OBSERVABILITY.md.
 python -m pytest tests/test_observability.py -x -q -m 'not slow'
 
+echo "== serving subset (frontend / admission / staleness invariant) =="
+# The online serving tier gets its own named gate: the shared HTTP
+# base (route dispatch, typed errors), admission control (in-flight
+# caps, depth shedding, 429 + Retry-After, graceful drain), mailbox
+# depth observability, the versioned serving read's metadata, the
+# /v1 endpoints, and the acceptance invariant — every served
+# response's max_staleness respects the configured bound while a
+# trainer pushes Adds concurrently (tests/test_serving.py;
+# docs/SERVING.md).
+python -m pytest tests/test_serving.py -x -q -m 'not slow'
+
 echo "== fault-tolerance subset (snapshots / rejoin / backup workers) =="
 # Crash-survival invariants get their own named gate: async snapshot
 # consistency + restore, dead-peer containment and retry, the BSP

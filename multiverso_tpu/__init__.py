@@ -51,6 +51,14 @@ def barrier() -> None:
     current_zoo().barrier()
 
 
+def serve_table(name: str, worker_table, vocab=None) -> None:
+    """Expose a worker table on this rank's online serving frontend
+    (``-serving_port``, docs/SERVING.md) under ``/v1/tables/<name>``;
+    ``vocab`` (word -> row id) enables the nearest-neighbor endpoint's
+    word lookups. No-op when serving is off."""
+    current_zoo().serve_table(name, worker_table, vocab)
+
+
 def rank() -> int:
     return current_zoo().rank
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import io as _io
 import os
+import urllib.error
 import urllib.request
 from typing import Callable, Dict, Optional, Union
 
@@ -185,8 +186,19 @@ class _HttpWriteStream(Stream):
         payload = self._buf.getvalue()
         req = _request(self._uri, data=payload, method="PUT")
         req.add_header("Content-Type", "application/octet-stream")
-        with _urlopen(req):  # noqa: S310 - scheme-gated
-            pass
+        try:
+            with _urlopen(req):  # noqa: S310 - scheme-gated
+                pass
+        except urllib.error.HTTPError as exc:
+            # The whole buffered object rides this one PUT: a rejection
+            # here means NOTHING was stored, and the generic HTTPError
+            # ("HTTP Error 507: ...") names neither the uri nor the
+            # fact that bytes were lost — the caller (checkpoint /
+            # snapshot writers) needs both to act on the failure.
+            raise IOError(
+                f"http write stream: PUT {self._uri} failed with "
+                f"status {exc.code} ({exc.reason}); {len(payload)} "
+                f"buffered bytes were NOT stored") from exc
 
 
 def _open_http(uri: str, mode: str) -> Stream:

@@ -9,79 +9,48 @@ Serves the controller's cluster-aggregated metrics view
   rank's shipped span events (load in ``chrome://tracing`` or
   https://ui.perfetto.dev).
 
-Read-only and dependency-free (``http.server``); one daemon thread per
-server, each request handled on its own thread
-(``ThreadingHTTPServer``) so a slow scraper cannot block a concurrent
-one. This is deliberately NOT a general app server — it is the scrape
-side of ROADMAP item 4's serving tier, and stays a leaf: handlers are
-plain callables injected by the runtime (no imports back into it).
+The HTTP plumbing itself (ThreadingHTTPServer lifecycle, dispatch,
+404/500 handling) lives in the shared ``io/http_server.py`` base,
+which the online serving tier (``serving/frontend.py``,
+docs/SERVING.md) builds on too; this module is just the fixed
+exact-path route table over it. Read-only and dependency-free; this is
+deliberately NOT a general app server — it is the scrape side, and
+stays a leaf: renderers are plain callables injected by the runtime
+(no imports back into it).
 """
 
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Tuple
 
-from ..util import log
+from .http_server import HttpServer, Response
 
 #: path -> () -> (content_type, body_bytes)
 Routes = Dict[str, Callable[[], Tuple[str, bytes]]]
 
 
-class MetricsHttpServer:
+class MetricsHttpServer(HttpServer):
     """Threaded HTTP server over a fixed route table."""
 
     def __init__(self, port: int, routes: Routes,
                  host: str = "0.0.0.0"):
         self._routes = dict(routes)
-        routes_ref = self._routes
+        super().__init__(port, self._resolve_path, host=host,
+                         name="metrics-http")
 
-        class _Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - http.server contract
-                route = routes_ref.get(self.path)
-                if route is None:
-                    self.send_error(404, "unknown path (served: "
-                                    + ", ".join(sorted(routes_ref))
-                                    + ")")
-                    return
-                try:
-                    ctype, body = route()
-                except Exception as exc:  # noqa: BLE001 - a broken
-                    # renderer must answer 500, not kill the handler
-                    # thread mid-response
-                    self.send_error(500, f"renderer failed: {exc}")
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    def _resolve_path(self, path: str):
+        route = self._routes.get(path)
+        if route is None:
+            return None
 
-            def log_message(self, fmt, *args):  # quiet: scrapes are
-                # periodic; stderr noise per poll helps nobody
-                log.debug("metrics_http: " + fmt, *args)
+        def handler(query):
+            ctype, body = route()
+            return Response(body, ctype)
+        return handler
 
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name=f"mv-metrics-http-{self.port}")
-        self._thread.start()
-        log.info("metrics http: serving %s on port %d",
-                 ", ".join(sorted(self._routes)), self.port)
-
-    @property
-    def port(self) -> int:
-        """The actually-bound port (differs from the requested one only
-        when constructed with port 0 — tests use the ephemeral bind)."""
-        return self._httpd.server_address[1]
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._thread.join(timeout=5)
+    def describe(self) -> str:
+        return ", ".join(sorted(self._routes))
 
 
 def prometheus_route(render: Callable[[], str]):

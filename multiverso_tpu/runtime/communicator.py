@@ -24,7 +24,7 @@ from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             is_controller_bound, is_server_bound,
                             is_wire_encoded, is_worker_bound, mark_error,
                             trace_of)
-from ..util import log, tracing
+from ..util import log, mt_queue, tracing
 from ..util.configure import define_bool, get_flag
 from ..util.dashboard import samples
 from ..util.lock_witness import named_condition, named_lock
@@ -171,6 +171,13 @@ class _DispatchQueues:
 class Communicator(Actor):
     def __init__(self, zoo) -> None:
         super().__init__(actors.COMMUNICATOR, zoo)
+        # Outbound pressure observable next to the server/worker
+        # mailboxes (MAILBOX_DEPTH[*] family, docs/SERVING.md),
+        # gated like theirs: the communicator mailbox is the hottest
+        # queue in the process, and a training-only run must not pay
+        # a reservoir append per message for samples nobody reads.
+        if mt_queue.depth_sampling_enabled():
+            self.mailbox.track_depth("MAILBOX_DEPTH[communicator]")
         self._net = zoo.net
         self._recv_thread: Optional[threading.Thread] = None
         # Filter stage: encode only over a real wire (in-process blobs

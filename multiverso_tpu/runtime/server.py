@@ -23,7 +23,7 @@ from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType, mark_error,
                             mark_replica_reply, stamp_version,
                             trace_of, unpack_add_batch)
-from ..util import log, tracing
+from ..util import log, mt_queue, tracing
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
 from . import actor as actors
@@ -100,6 +100,14 @@ class Server(Actor):
 
     def __init__(self, zoo) -> None:
         super().__init__(actors.SERVER, zoo)
+        # Mailbox pressure is the admission-control signal of the
+        # serving tier (serving/admission.py sheds over the high
+        # watermark) and a bench observable (docs/SERVING.md) — record
+        # per-push depth into the MAILBOX_DEPTH[*] Samples family.
+        # Gated: a training-only deployment must not pay a reservoir
+        # append per message for samples nobody reads.
+        if mt_queue.depth_sampling_enabled():
+            self.mailbox.track_depth("MAILBOX_DEPTH[server]")
         self._store: List = []  # registered ServerTables, indexed by table id
         self.register_handler(MsgType.Request_Get, self._process_get)
         self.register_handler(MsgType.Request_Add, self._process_add)

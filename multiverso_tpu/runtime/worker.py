@@ -29,7 +29,7 @@ from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             pack_add_batch, replica_row_count,
                             reply_version, stamp_trace, take_error,
                             trace_of)
-from ..util import tracing
+from ..util import mt_queue, tracing
 from ..util.configure import define_bool, define_double, get_flag
 from ..util.dashboard import count as count_event
 from ..util.dashboard import monitor
@@ -60,6 +60,11 @@ MAX_BATCH_BYTES = 4 << 20
 class Worker(Actor):
     def __init__(self, zoo) -> None:
         super().__init__(actors.WORKER, zoo)
+        # Depth samples feed the serving tier's pressure surface and
+        # the bench's mailbox report (docs/SERVING.md); gated so a
+        # training-only run pays nothing per push.
+        if mt_queue.depth_sampling_enabled():
+            self.mailbox.track_depth("MAILBOX_DEPTH[worker]")
         self._cache: List = []  # registered WorkerTables, indexed by table id
         self.register_handler(MsgType.Request_Get, self._process_get)
         self.register_handler(MsgType.Request_Add, self._process_add)

@@ -25,6 +25,13 @@ import numpy as np
 from ..core.blob import Blob
 from ..core.message import Message, MsgType, take_error
 from ..core.node import Node, Role, is_server, is_worker, role_from_string
+# Imported eagerly so the -serving_* flag definitions are registered
+# before Zoo.start parses the command line (the -snapshot_* precedent
+# in runtime/server.py). Only the admission half: it is io-/runtime-
+# import-free, while the frontend pulls in io/ (-> stream -> this
+# module — a cycle at import time) and is therefore loaded lazily in
+# _start_serving.
+from ..serving import admission as _serving_admission  # noqa: F401
 from ..util import log
 from ..util.configure import (define_bool, define_double, define_int,
                               define_string, get_flag, parse_cmd_flags)
@@ -103,6 +110,8 @@ class Zoo:
         # -- observability (runtime/metrics.py, io/metrics_http.py) --
         self._metrics_reporter = None
         self._metrics_http = None
+        # -- online serving tier (serving/frontend.py, docs/SERVING.md) --
+        self._serving = None
 
     # -- lifecycle (ref: src/zoo.cpp:41-60) --
     def start(self, argv: Optional[List[str]] = None,
@@ -133,6 +142,7 @@ class Zoo:
                 self._heartbeat = HeartbeatMonitor(self)
                 self._heartbeat.start()
             self._start_observability()
+            self._start_serving()
         self._started = True
         log.debug("Rank %d: multiverso started", self.rank)
         return remaining
@@ -166,10 +176,44 @@ class Zoo:
         if self._metrics_reporter is not None:
             self._metrics_reporter.flush()
 
+    def _start_serving(self) -> None:
+        """The online serving frontend (-serving_port,
+        docs/SERVING.md) on ranks hosting a worker actor — serving
+        reads route through worker tables, so a pure-server rank has
+        nothing to serve from. No-op at the default flag value."""
+        port = int(get_flag("serving_port", 0))
+        if port > 0 and self._actors.get(actors.WORKER) is not None:
+            from ..serving.frontend import ServingFrontend
+            self._serving = ServingFrontend(self, port)
+
+    @property
+    def serving(self):
+        """The live ServingFrontend, or None (flag off / no worker)."""
+        return self._serving
+
+    def serve_table(self, name: str, worker_table,
+                    vocab: Optional[dict] = None) -> None:
+        """Expose a worker table on the serving frontend under
+        ``/v1/tables/<name>`` (``vocab``: word -> row id, enables the
+        neighbors endpoint's word lookups). Safe to call with serving
+        off — the registration is simply skipped, so application code
+        need not fork on the flag."""
+        if self._serving is None:
+            log.debug("Rank %d: serve_table(%r) ignored — serving "
+                      "frontend off (-serving_port)", self.rank, name)
+            return
+        self._serving.register_table(name, worker_table, vocab)
+
     def stop(self, finalize_net: bool = True) -> None:
         """ref: src/zoo.cpp:52-60,104-114."""
         if not self._started:
             return
+        if self._serving is not None:
+            # FIRST: the frontend's graceful drain needs the worker/
+            # communicator stack still alive to finish in-flight reads;
+            # once drained, no new HTTP work can reach the actors.
+            self._serving.stop()
+            self._serving = None
         if self._metrics_reporter is not None:
             self._metrics_reporter.stop()
             self._metrics_reporter = None

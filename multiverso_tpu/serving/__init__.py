@@ -1,0 +1,24 @@
+"""Online serving tier: the parameter server as a read-mostly
+inference service (docs/SERVING.md).
+
+``frontend.ServingFrontend`` is the HTTP surface (started by the zoo
+on ``-serving_port``, tables registered via ``mv.serve_table``);
+``admission.AdmissionController`` is its survival-under-load half
+(in-flight caps, mailbox-depth shedding, graceful drain).
+
+``ServingFrontend`` is re-exported LAZILY: the zoo imports this
+package at module load for -serving_* flag registration
+(``admission.py``), before ``io/``'s stream module — which the
+frontend pulls in — can be imported without a cycle.
+"""
+
+from .admission import AdmissionController, ShedError
+
+__all__ = ["AdmissionController", "ServingFrontend", "ShedError"]
+
+
+def __getattr__(name):
+    if name == "ServingFrontend":
+        from .frontend import ServingFrontend
+        return ServingFrontend
+    raise AttributeError(name)

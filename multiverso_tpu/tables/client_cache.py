@@ -328,6 +328,26 @@ class RowCache:
                 self._floor[r] = max(self._floor.get(r, -1),
                                      self._tracker.latest(int(s)))
 
+    @property
+    def bound(self) -> int:
+        """The staleness bound this cache was constructed with (serving
+        tier response metadata, docs/SERVING.md)."""
+        return self._bound
+
+    def versions_of(self, row_ids) -> Dict[int, int]:
+        """Fetch version per requested row currently present (rows
+        absent — evicted, never fetched, or blocked by a pending
+        own-add — are simply omitted). Serving-tier metadata read: the
+        frontend reports the minimum served version and the per-row
+        staleness against the tracker on every response."""
+        out: Dict[int, int] = {}
+        with self._lock:
+            for r in np.unique(np.asarray(row_ids).reshape(-1)):
+                ent = self._rows.get(int(r))
+                if ent is not None:
+                    out[int(r)] = ent[0]
+        return out
+
     def invalidate_server(self, server_id: int) -> None:
         """Drop every row owned by a shard whose server changed
         generation (restart + snapshot restore): entries and floors

@@ -257,9 +257,7 @@ class MatrixWorker(WorkerTable):
         self._row_cache: Optional[RowCache] = None
         if bound > 0 and not self.is_sparse:
             self._row_cache = RowCache(
-                bound,
-                lambda rows: np.minimum(rows // self._row_length,
-                                        self._num_server - 1),
+                bound, self._server_of_rows,
                 self._num_server, self._version_tracker)
             self._caches.append(self._row_cache)
         # In-flight prefetch registry (+ dedup/join): msg_id -> sorted
@@ -290,6 +288,20 @@ class MatrixWorker(WorkerTable):
             self._replica_router = replica_mod.ReplicaRouter(
                 self._num_server, salt=max(self._zoo.rank, 0),
                 preferred=local_sid if local_sid >= 0 else None)
+
+    def _server_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Vectorized row ids -> owning server ids (the row-range
+        sharding rule; shared by the client cache's freshness checks
+        and the serving tier's version attribution)."""
+        return np.minimum(rows // self._row_length, self._num_server - 1)
+
+    def observed_versions(self) -> Dict[int, int]:
+        """Latest shard version this worker has OBSERVED, per server id
+        (-1 before any reply). Serving-tier metadata (docs/SERVING.md):
+        staleness is measured against these, exactly as the client
+        cache measures it."""
+        return {s: self._version_tracker.latest(s)
+                for s in range(self._num_server)}
 
     def _check_row_ids(self, row_ids: np.ndarray) -> None:
         """Fail fast in the CALLER on out-of-range ids. partition() runs
@@ -353,6 +365,84 @@ class MatrixWorker(WorkerTable):
                 return joined
             return self._request_get(Blob(missing.view(np.uint8)))
         return self._request_get(Blob(row_ids.view(np.uint8)))
+
+    # -- serving-tier read (serving/frontend.py, docs/SERVING.md) --
+    def read_rows_versioned(self, row_ids, out: Optional[np.ndarray]
+                            = None):
+        """``get_rows`` plus the version metadata an inference response
+        must carry: ``(values, meta)`` where meta holds
+
+        - ``served_version``: the MINIMUM fetch version among the
+          requested rows (how old the oldest byte served is);
+        - ``latest_version``: the newest shard version this worker has
+          observed among the shards the request touched;
+        - ``max_staleness``: the largest per-row (shard latest - row
+          fetch version) gap — by the cache's freshness invariant this
+          never exceeds ``staleness_bound`` at serve time;
+        - ``staleness_bound``: the active ``-max_get_staleness`` bound
+          (0 = cache disabled, every row crossed the wire);
+        - ``cache_hit``: True when the whole request was served locally
+          (no wire message at all);
+        - ``rows_requested`` / ``rows_cached``: unique rows asked for
+          and how many of them the cache covered (row-granular
+          coverage — a partial hit fetches only the remainder).
+
+        The shard latests are read BEFORE the get and the per-row
+        versions AFTER it: versions only ever grow, so every served
+        row passed its freshness check against a latest AT LEAST the
+        pre-read (``v >= latest_at_lookup - bound >= pre_latest -
+        bound``), and a wire-fetched row's version postdates the
+        pre-read entirely — the reported ``max_staleness <=
+        staleness_bound`` invariant is race-free even while a trainer
+        pushes Adds concurrently. (Reading latest AFTER the get would
+        measure rows against observations the serve never saw and
+        overshoot the bound spuriously.)
+
+        Same concurrency contract as ``get_rows``: one Get in flight
+        per table — the serving frontend serializes calls per table.
+        """
+        row_ids = np.ascontiguousarray(row_ids,
+                                       dtype=np.int32).reshape(-1)
+        uniq = np.unique(row_ids)
+        sids = self._server_of_rows(uniq)
+        latest_by_sid = {int(s): self._version_tracker.latest(int(s))
+                         for s in np.unique(sids)}
+        cache = self._row_cache
+        hits_before = cache.hits if cache is not None else 0
+        rows_hit_before = cache.rows_hit if cache is not None else 0
+        values = self.get_rows(row_ids, out)
+        cache_hit = (cache is not None
+                     and cache.hits == hits_before + 1)
+        # Row-granular coverage: how many of the requested unique rows
+        # the cache served locally (the miss fetched only the rest).
+        # Exact under the serving frontend's per-table serialization —
+        # fetch_into is the only rows_hit writer and only get paths
+        # call it.
+        rows_cached = (cache.rows_hit - rows_hit_before
+                       if cache is not None else 0)
+        latest = max(latest_by_sid.values(), default=-1)
+        served = latest
+        max_stale = 0
+        if cache is not None:
+            versions = cache.versions_of(uniq)
+            for r, s in zip(uniq, sids):
+                v = versions.get(int(r))
+                if v is None:
+                    continue  # wire-fetched fresh / evicted: staleness 0
+                served = min(served, v)
+                max_stale = max(max_stale,
+                                latest_by_sid[int(s)] - v)
+                latest = max(latest, v)  # a fetch newer than the
+                # pre-read keeps served <= latest consistent
+        return values, {
+            "served_version": int(served),
+            "latest_version": int(latest),
+            "max_staleness": int(max(max_stale, 0)),
+            "staleness_bound": int(cache.bound
+                                   if cache is not None else 0),
+            "cache_hit": bool(cache_hit),
+            "rows_requested": int(uniq.size),
+            "rows_cached": int(rows_cached)}
 
     # -- client-cache prefetch + in-flight Get dedup --
     def prefetch_rows_async(self, row_ids) -> int:

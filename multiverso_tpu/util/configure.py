@@ -88,6 +88,14 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "trace_buffer": 4096,
     "metrics_interval_s": 0.0,
     "metrics_port": 0,
+    # -- online serving tier (serving/frontend.py,
+    #    serving/admission.py; docs/SERVING.md) --
+    "serving_port": 0,
+    "serving_max_rows": 4096,
+    "serving_max_inflight": 64,
+    "serving_shed_depth": 256,
+    "serving_retry_after_s": 0.05,
+    "serving_drain_s": 5.0,
     # -- wordembedding model (models/wordembedding/) --
     "train_file": "",
     "output_file": "vectors.txt",

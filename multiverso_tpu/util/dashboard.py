@@ -65,6 +65,12 @@ METRIC_NAMES: Dict[str, str] = {
     "DISPATCH_QUEUE_DEPTH[d*]": "per-destination queue depth at submit",
     # -- observability export (runtime/metrics.py) --
     "METRICS_REPORT": "per-rank metrics snapshots shipped",
+    # -- actor mailboxes (util/mt_queue.py track_depth) --
+    "MAILBOX_DEPTH[*]": "actor mailbox depth at each push",
+    # -- online serving tier (serving/; docs/SERVING.md) --
+    "SERVING_REQUESTS": "serving-frontend requests admitted and served",
+    "SERVING_SHED": "serving-frontend requests rejected by admission",
+    "SERVING_LATENCY_MS": "serving-frontend request latency (ms)",
 }
 
 #: Version stamp on serialized metrics snapshots

@@ -14,11 +14,25 @@ import itertools
 import time
 from typing import Deque, Generic, Optional, Tuple, TypeVar
 
+from .configure import get_flag
+from .dashboard import samples
 from .lock_witness import named_condition, named_lock
 
 T = TypeVar("T")
 
 _serial = itertools.count()
+
+
+def depth_sampling_enabled() -> bool:
+    """Whether actor mailboxes should pay the per-push depth SAMPLE
+    (reservoir lock + append per message on hot paths): only when
+    something actually consumes the samples — the serving tier's
+    pressure surface (-serving_port) or the metrics exporter
+    (-metrics_interval_s). The high watermark alone is one compare and
+    stays tracked unconditionally. Read at actor construction, after
+    flag parsing (the -sparse_compress precedent)."""
+    return (int(get_flag("serving_port", 0)) > 0
+            or float(get_flag("metrics_interval_s", 0.0)) > 0)
 
 
 class MtQueue(Generic[T]):
@@ -28,11 +42,49 @@ class MtQueue(Generic[T]):
         self._mutex = named_lock(name)
         self._cond = named_condition(f"{name}.cond", self._mutex)
         self._exit = False
+        # Depth observability (docs/SERVING.md admission control +
+        # bench mailbox-pressure reporting): the high watermark is
+        # always tracked (one compare per push); per-push depth
+        # SAMPLES (p50/p99 via util/dashboard.py Samples) only when a
+        # metric name was opted in via track_depth — the reservoir's
+        # lock + append per push is real cost on a hot mailbox.
+        self._depth_high = 0
+        self._depth_metric: Optional[str] = None
+
+    def track_depth(self, metric_name: str) -> None:
+        """Record every post-push depth into the named Dashboard
+        ``Samples`` reservoir (``MAILBOX_DEPTH[*]`` family). The server
+        and worker actors opt their mailboxes in: admission-control
+        decisions and the serving bench both read mailbox pressure."""
+        self._depth_metric = metric_name
 
     def push(self, item: T) -> None:
         with self._cond:
             self._buffer.append(item)
+            depth = len(self._buffer)
+            if depth > self._depth_high:
+                self._depth_high = depth
             self._cond.notify()
+        if self._depth_metric is not None:
+            # Outside the queue lock: the reservoir has its own, and a
+            # sampler must never extend this queue's critical section.
+            # Re-resolved per push (not cached) so a bench-phase
+            # reset_samples() cannot orphan the writer (the
+            # dashboard.monitor re-resolve precedent).
+            samples(self._depth_metric).add(depth)
+
+    @property
+    def depth_high_watermark(self) -> int:
+        """Deepest the queue has ever been (monotonic; cheap enough to
+        track unconditionally)."""
+        with self._mutex:
+            return self._depth_high
+
+    def reset_depth_watermark(self) -> None:
+        """Re-anchor the watermark at the current depth (bench windows
+        measure per-phase pressure, not lifetime)."""
+        with self._mutex:
+            self._depth_high = len(self._buffer)
 
     def pop(self, timeout: Optional[float] = None) -> Optional[T]:
         """Block until an item is available; None once exited (or timeout)."""

@@ -199,7 +199,13 @@ class TestHttpStream:
                 if not self._authorized():
                     return
                 length = int(self.headers.get("Content-Length", 0))
-                store[self.path] = self.rfile.read(length)
+                body = self.rfile.read(length)
+                fail = store.get("__fail_put__")
+                if fail is not None:  # transport-failure injection
+                    self.send_response(int(fail))
+                    self.end_headers()
+                    return
+                store[self.path] = body
                 self.send_response(201)
                 self.end_headers()
 
@@ -235,6 +241,28 @@ class TestHttpStream:
         assert store["/obj/blob.bin"] == payload
         with StreamFactory.get_stream(f"{base}/obj/blob.bin", "r") as s:
             assert s.read() == payload
+
+    def test_put_failure_surfaces_ioerror_naming_uri_and_status(
+            self, http_store):
+        """The whole buffered object rides close()'s one PUT: a
+        rejected PUT must surface as an IOError naming the uri and
+        the HTTP status — not vanish (the caller thinks the object
+        was stored) and not read as a generic urllib message that
+        names neither."""
+        import multiverso_tpu.io.http_stream  # noqa: F401 - registers scheme
+        base, store = http_store
+        store["__fail_put__"] = 507  # Insufficient Storage
+        uri = f"{base}/obj/lost.bin"
+        stream = StreamFactory.get_stream(uri, "w")
+        stream.write(b"precious bytes")
+        with pytest.raises(IOError) as exc:
+            stream.close()
+        assert uri in str(exc.value)
+        assert "507" in str(exc.value)
+        assert "/obj/lost.bin" not in store  # nothing silently stored
+        assert not stream.good()   # the stream IS closed
+        stream.close()             # idempotent: no second PUT attempt
+        del store["__fail_put__"]
 
     def test_auth_headers_attached(self, http_store):
         # The hdfs role was an AUTHENTICATED store
