@@ -1125,10 +1125,17 @@ def run_wire_codec() -> dict:
 
 def _allreduce_world(world: int, algo: str, pace_mbps: float,
                      lossy: bool, transport: str, n_elems: int,
-                     reps: int = 2) -> dict:
+                     reps: int = 2, fill: float = 1.0,
+                     codec: bool = True, sharded: bool = False) -> dict:
     """One engine configuration: ``world`` thread-ranks allreducing a
     ``n_elems`` fp32 buffer, over LocalFabric or localhost TCP (paced
-    to emulate the DCN wire). Returns best wall time + bytes on wire."""
+    to emulate the DCN wire). ``fill`` < 1 draws power-law sparse
+    inputs (pareto magnitudes on a random support, the MA-delta wire
+    shape); ``codec=False`` disables the wire codec — the dense-RAW
+    baseline an MA round shipping full parameters pays; ``sharded``
+    runs ``sharded_average`` instead (mean semantics). Returns best
+    wall time + bytes on wire + the engine's algorithm pick and
+    per-rank reduce-state bytes."""
     import threading
     from multiverso_tpu.runtime.allreduce_engine import AllreduceEngine
     from multiverso_tpu.runtime.net import LocalFabric
@@ -1138,6 +1145,7 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
     set_flag("allreduce_algo", algo)
     set_flag("allreduce_lossy", lossy)
     set_flag("net_pace_mbps", pace_mbps)
+    set_flag("wire_codec", codec)
     nets = []
     try:
         if transport == "tcp":
@@ -1154,21 +1162,39 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
             nets = [fabric.endpoint(r) for r in range(world)]
         engines = [AllreduceEngine(n) for n in nets]
         rng = np.random.default_rng(11)
-        # Bounded dynamic range: int8-eligible, the shape of
-        # normalized model-average deltas.
-        inputs = [(np.sign(rng.standard_normal(n_elems))
-                   * rng.uniform(0.5, 1.5, n_elems)).astype(np.float32)
-                  for _ in range(world)]
+        if fill < 1.0:
+            nnz = max(int(n_elems * fill), 1)
+            inputs = []
+            for _ in range(world):
+                x = np.zeros(n_elems, np.float32)
+                idx = np.sort(rng.choice(n_elems, nnz, replace=False))
+                x[idx] = ((rng.pareto(2.0, nnz) + 0.1)
+                          * np.sign(rng.standard_normal(nnz))
+                          ).astype(np.float32)
+                inputs.append(x)
+        else:
+            # Bounded dynamic range: int8-eligible, the shape of
+            # normalized model-average deltas.
+            inputs = [(np.sign(rng.standard_normal(n_elems))
+                       * rng.uniform(0.5, 1.5, n_elems))
+                      .astype(np.float32) for _ in range(world)]
         expected = np.sum([x.astype(np.float64) for x in inputs], axis=0)
+        if sharded:
+            expected = expected / world
         results = [None] * world
         best = float("inf")
         wire = 0
+
+        def call(r):
+            if sharded:
+                return engines[r].sharded_average(inputs[r])
+            return engines[r].allreduce(inputs[r])
+
         for _ in range(reps):
             before = sum(n.bytes_sent for n in nets)
             t0 = time.perf_counter()
             threads = [threading.Thread(
-                target=lambda r=r: results.__setitem__(
-                    r, engines[r].allreduce(inputs[r])))
+                target=lambda r=r: results.__setitem__(r, call(r)))
                 for r in range(world)]
             for t in threads:
                 t.start()
@@ -1180,10 +1206,14 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
         tol = 0.2 if lossy else 1e-3
         np.testing.assert_allclose(results[0], expected, rtol=tol,
                                    atol=tol)
-        return {"sec": round(best, 4), "wire_mb": round(wire / 1e6, 3)}
+        return {"sec": round(best, 4), "wire_mb": round(wire / 1e6, 3),
+                "algo": engines[0].last_algo,
+                "reduce_state_mb": round(
+                    engines[0].last_reduce_state_bytes / 1e6, 3)}
     finally:
         set_flag("net_pace_mbps", 0.0)
         set_flag("allreduce_lossy", False)
+        set_flag("wire_codec", True)
         if transport == "tcp":
             for n in nets:
                 n.finalize()
@@ -1299,6 +1329,201 @@ def _ma_overlap_stall(pace_mbps: float = 100.0) -> dict:
     }
 
 
+def _sparse_allreduce_points(n: int, pace: float,
+                             dense_ring: dict) -> dict:
+    """Sparse-stream tier points (docs/ALLREDUCE.md): power-law blobs
+    at 1%/5%/20% fill on the same logical size, over the paced TCP
+    wire. ``dense_ring`` is the ring on a DENSE payload of that size —
+    its segments fail ``worth_encoding`` so every frame rides RAW: the
+    bytes an MA round shipping full parameters pays today (the codec
+    stays negotiated-on but inert; a future ``worth_encoding`` change
+    that starts encoding dense payloads would shift this baseline's
+    meaning). Also vs the ring WITH per-segment codec sparse encoding
+    engaged on the same SPARSE payload (the strongest dense-path
+    configuration). Plus the dense-input auto regression (the nnz
+    probe is the only added cost) and the sharded-average
+    reduce-state ratio."""
+    out = {}
+    for fill in (0.01, 0.05, 0.20):
+        point = {}
+        for world in (2, 3):
+            sp = _allreduce_world(world, "auto", pace, False, "tcp", n,
+                                  fill=fill)
+            base = dense_ring[world]
+            point[f"{world}rank"] = {
+                **sp,
+                "bytes_vs_dense_ring": round(
+                    sp["wire_mb"] / base["wire_mb"], 4),
+                "speedup_vs_dense_ring": round(
+                    base["sec"] / sp["sec"], 3),
+            }
+        out[f"fill_{int(fill * 100)}pct"] = point
+    # The strongest dense-path config on the same 5% payload: the ring
+    # with per-segment sparse codec frames (partial sums still ride
+    # every hop and densify; the sparse tier ships each contribution
+    # once).
+    out["ring_codec_5pct_3rank"] = _allreduce_world(
+        3, "ring", pace, False, "tcp", n, fill=0.05)
+    # Dense inputs above break-even: auto (probe + pick) vs forced
+    # ring — the regression budget is 5%.
+    auto_dense = _allreduce_world(3, "auto", pace, False, "tcp", n)
+    out["dense_auto"] = {
+        **auto_dense,
+        "regression_vs_forced_ring": round(
+            auto_dense["sec"] / dense_ring[3]["sec"], 3),
+    }
+    # Sharded average: per-rank reduce state ~ 1/world of the buffer.
+    sh = _allreduce_world(3, "auto", 0.0, False, "local", n,
+                          fill=0.05, sharded=True)
+    out["sharded_avg_3rank"] = {
+        **sh,
+        "reduce_state_vs_buffer": round(
+            sh["reduce_state_mb"] / (n * 4 / 1e6), 4),
+    }
+    return out
+
+
+def _ma_sharded_arm(pace_mbps: float = 200.0) -> dict:
+    """MACorpusTrainer sharded (delta-vs-last-average over the sparse
+    sharded collective) vs the dense MA trainer on the same schedule,
+    over a paced 2-rank TCP wire: bytes on wire, wall, measured delta
+    fill, per-rank reduce-state — and the lossless bit-identity proof:
+    the sharded run's embeddings equal the SAME delta schedule forced
+    down the unchunked dense ring, bit for bit."""
+    import threading
+    import types
+    from multiverso_tpu.models.wordembedding import (
+        Dictionary, MACorpusTrainer, TokenizedCorpus, Word2Vec,
+        Word2VecConfig)
+    from multiverso_tpu.runtime.tcp import TcpNet
+    from multiverso_tpu.runtime import device_lock
+    from multiverso_tpu.util.configure import set_flag
+    from multiverso_tpu.util.dashboard import Dashboard, samples
+    from multiverso_tpu.util.net_util import free_listen_port
+
+    rng = np.random.default_rng(3)
+    # Zipf token draws over a wide vocabulary: each averaging round
+    # touches only the rows its batches hit, so the delta is sparse —
+    # the regime the sparse tier exists for.
+    vocab = [f"w{i}" for i in range(12000)]
+    probs = 1.0 / np.arange(1, len(vocab) + 1) ** 1.3
+    probs /= probs.sum()
+    lines = [" ".join(rng.choice(vocab, size=20, p=probs))
+             for _ in range(700)]
+    path = os.path.join(tempfile.mkdtemp(), "ma_sparse_corpus.txt")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    d = Dictionary.build(path, min_count=1)
+    tok = TokenizedCorpus.build(d, path)
+    set_flag("net_pace_mbps", pace_mbps)
+    set_flag("allreduce_lossy", False)
+    device_lock.enable()
+
+    def run_mode(sharded: bool, dense_ring_delta: bool = False):
+        eps = [f"127.0.0.1:{free_listen_port()}" for _ in range(2)]
+        nets = [TcpNet(r, eps) for r in range(2)]
+        if dense_ring_delta:
+            # Same delta schedule, dense collective: route
+            # sharded_average through allreduce/n on the UNCHUNKED
+            # ring (one chunk = the sharded fold's association).
+            set_flag("allreduce_algo", "ring")
+            set_flag("allreduce_chunk_kb", 1 << 20)
+            for net in nets:
+                net.sharded_average = types.MethodType(
+                    lambda self, arr, slot=None:
+                    self.allreduce(arr, slot) / self.size, net)
+        else:
+            set_flag("allreduce_algo", "auto")
+        mon = Dashboard.get("MA_COMM_STALL")
+        stall0 = mon.elapse
+        embs = [None, None]
+        errs = [None, None]
+        rounds = [0, 0]
+
+        def body(rank):
+            try:
+                config = Word2VecConfig(
+                    embedding_size=64, window=2, epochs=1,
+                    init_learning_rate=0.02, batch_size=1024,
+                    sample=0, negative=2, seed=23)
+                model = Word2Vec(config, d)
+                trainer = MACorpusTrainer(
+                    model, tok, avg_every=1, overlap=True,
+                    sharded=sharded,
+                    zoo=types.SimpleNamespace(net=nets[rank]),
+                    centers_per_step=256, steps_per_dispatch=1)
+                trainer.train_epoch(seed=0, max_steps=24)
+                trainer.finish()
+                embs[rank] = np.asarray(model._emb_in).copy()
+                rounds[rank] = trainer.comm_rounds
+            except BaseException as exc:  # noqa: BLE001
+                errs[rank] = exc
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=body, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        hung = [t.name for t in threads if t.is_alive()]
+        wall = time.perf_counter() - t0
+        wire = sum(n.bytes_sent for n in nets)
+        state = max(
+            getattr(getattr(n, "_allreduce_engine", None),
+                    "last_reduce_state_bytes", 0) for n in nets)
+        for n in nets:
+            n.finalize()
+        for exc in errs:
+            if exc is not None:
+                raise exc
+        assert not hung, f"ma trainer rank hung: {hung}"
+        return {"wall_sec": round(wall, 2),
+                "wire_mb": round(wire / 1e6, 2),
+                "stall_ms": round(mon.elapse - stall0, 1),
+                "comm_rounds": rounds[0],
+                "reduce_state_mb": round(state / 1e6, 3)}, embs
+
+    try:
+        # Dense first: it pays the one-time trainer jit compile, so
+        # the two delta arms (and their bit-identity) compare warm.
+        dense_res, _ = run_mode(False)
+        fill_s = samples("SPARSE_FILL[input]")
+        fills_before = fill_s.count
+        sharded_res, sharded_embs = run_mode(True)
+        fills = fill_s.export_recent(
+            max(fill_s.count - fills_before, 1))
+        ring_res, ring_embs = run_mode(True, dense_ring_delta=True)
+    finally:
+        device_lock.disable()
+        set_flag("net_pace_mbps", 0.0)
+        set_flag("allreduce_algo", "auto")
+        set_flag("allreduce_chunk_kb", 512)
+    identical = all(np.array_equal(sharded_embs[r], ring_embs[r])
+                    for r in range(2))
+    params_mb = sharded_embs[0].size * 2 * 4 / 1e6  # emb_in + emb_out
+    return {
+        "emulated_wire_mbps": pace_mbps,
+        "model_params_mb": round(params_mb, 2),
+        "sharded_sparse": sharded_res,
+        "dense_ma": dense_res,
+        "delta_dense_ring": ring_res,
+        "wire_reduction_vs_dense_ma": round(
+            dense_res["wire_mb"] / max(sharded_res["wire_mb"], 1e-6),
+            3),
+        "stall_reduction_vs_dense_ma": round(
+            dense_res["stall_ms"] / max(sharded_res["stall_ms"], 1e-3),
+            3),
+        "note": "dense_ma runs first and absorbs the one-time trainer "
+                "jit compile in wall_sec; wire/stall compare cleanly",
+        "median_delta_fill": round(float(np.median(fills)), 4)
+        if fills else None,
+        "reduce_state_vs_params": round(
+            sharded_res["reduce_state_mb"] / params_mb, 4),
+        "bit_identical_sharded_vs_dense_ring_delta": identical,
+    }
+
+
 def run_allreduce() -> dict:
     """Collective-stack phase: chunked pipelined ring vs monolithic
     recursive halving, lossless vs int8 error-feedback, on a 4 MB fp32
@@ -1315,11 +1540,13 @@ def run_allreduce() -> dict:
            "note": "single-core host: every rank, writer thread and "
                    "codec pass time-shares one core"}
     try:
+        dense_ring = {}
         for world in (2, 3):
             mono = _allreduce_world(world, "rhalving", pace, False,
                                     "tcp", n)
             ring = _allreduce_world(world, "ring", pace, False,
                                     "tcp", n)
+            dense_ring[world] = ring
             ring_i8 = _allreduce_world(world, "ring", pace, True,
                                        "tcp", n)
             local = {
@@ -1352,6 +1579,16 @@ def run_allreduce() -> dict:
         out["ring_speedup"] = out["tcp_3rank"]["ring_speedup"]
         out["int8_wire_reduction"] = \
             out["tcp_3rank"]["int8_wire_reduction"]
+        # Sparse-stream tier points + the sharded MA arm
+        # (docs/ALLREDUCE.md sparse tier; acceptance: 5% fill bytes
+        # <= 0.25x / speedup >= 1.5x vs the dense ring, dense auto
+        # regression <= 5%, reduce-state ~ 1/world).
+        out["sparse"] = _sparse_allreduce_points(n, pace, dense_ring)
+        out["sparse_bytes_vs_dense_ring"] = \
+            out["sparse"]["fill_5pct"]["3rank"]["bytes_vs_dense_ring"]
+        out["sparse_speedup_vs_dense_ring"] = \
+            out["sparse"]["fill_5pct"]["3rank"]["speedup_vs_dense_ring"]
+        out["ma_sharded"] = _ma_sharded_arm()
         out["ma_overlap"] = _ma_overlap_stall()
     finally:
         set_flag("allreduce_algo", "auto")
@@ -2113,7 +2350,7 @@ _PHASE_EST = {
     "ps_two_workers": 60, "ps_two_servers": 150,
     "tcp_one_process": 65, "tcp_two_process": 110,
     "matrix_bandwidth": 60, "local_retime": 60,
-    "wire_codec": 15, "client_cache": 45, "allreduce": 120,
+    "wire_codec": 15, "client_cache": 45, "allreduce": 260,
     "observability": 60,
 }
 

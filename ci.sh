@@ -48,6 +48,16 @@ echo "== fast wire-codec + client-cache + allreduce subsets =="
 # itself, not hide inside the full run's output.
 python -m pytest tests/test_wire_codec.py tests/test_client_cache.py -x -q
 
+echo "== sparse-allreduce subset (index-union reduce / switchover / sharded avg) =="
+# The sparse collective tier gets its own named gate: choose_algo path
+# pinning per (size, density, world), index-union merge correctness vs
+# numpy, the switchover boundary (results bit-equal on both sides of
+# the cutoff), lossy sparse error feedback, sharded-average
+# bit-identity + 1/world reduce-state, and the mixed sparse/dense
+# generation-tag regression (docs/ALLREDUCE.md sparse tier).
+python -m pytest tests/test_allreduce.py -x -q \
+    -k "Sparse or ChooseAlgo or Sharded"
+
 echo "== allreduce engine (ring / rhalving / lossy EF / async writer) =="
 python -m pytest tests/test_allreduce.py -x -q
 

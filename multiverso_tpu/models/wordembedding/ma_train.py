@@ -28,7 +28,7 @@ from typing import Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ...parallel.ma import MAAverager
+from ...parallel.ma import MAAverager, MAShardedAverager
 from .device_train import DeviceCorpusTrainer, TokenizedCorpus
 
 
@@ -38,16 +38,26 @@ class MACorpusTrainer:
     All ranks must construct their model with the same config seed (MA
     assumes replicas start identical) and call ``train_epoch`` the same
     number of times with the same group counts — the averages are
-    matched positionally across ranks, like every collective."""
+    matched positionally across ranks, like every collective.
+
+    ``sharded=True`` switches to delta-vs-last-average MA over the
+    sharded sparse collective (:class:`MAShardedAverager`): each round
+    ships only the parameters' change since the last average — sparse
+    once training localizes — through a reduce-scatter of codec sparse
+    frames, a shard-local divide, and an allgather. The submit/collect
+    call points are identical, so sync and overlapped sharded runs stay
+    bit-identical to each other exactly like the dense mode's."""
 
     def __init__(self, model, tokenized: TokenizedCorpus,
                  avg_every: int = 4, overlap: bool = True, zoo=None,
-                 **trainer_kw):
+                 sharded: bool = False, **trainer_kw):
         self.model = model
         self.avg_every = max(1, int(avg_every))
         self.overlap = bool(overlap)
+        self.sharded = bool(sharded)
         self._inner = DeviceCorpusTrainer(model, tokenized, **trainer_kw)
-        self._averager = MAAverager(zoo)
+        self._averager = MAShardedAverager(zoo) if self.sharded \
+            else MAAverager(zoo)
         self.comm_rounds = 0
 
     # -- host <-> device parameter shuttling --

@@ -193,6 +193,114 @@ class MAAverager:
         return avg + (np.asarray(current) - snapshot)
 
 
+def sharded_model_average(data: np.ndarray, zoo=None) -> np.ndarray:
+    """Blocking cross-rank average through the sharded collective:
+    reduce-scatter of sparse codec frames, shard-local divide,
+    allgather (``net.sharded_average``). Same MA_COMM_STALL accounting
+    and positional-matching contract as ``model_average``."""
+    zoo = zoo if zoo is not None else current_zoo()
+    with monitor("MA_COMM_STALL"):
+        return zoo.net.sharded_average(np.asarray(data))
+
+
+def sharded_model_average_async(data: np.ndarray, zoo=None, *,
+                                copy: bool = True) -> MAFuture:
+    """``model_average_async`` over the sharded collective: snapshots
+    the input (unless ``copy=False`` hands over a private buffer),
+    reserves the endpoint's FIFO slot on the calling thread, and
+    resolves the future with the averaged array — the divide already
+    applied shard-locally inside the collective."""
+    zoo = zoo if zoo is not None else current_zoo()
+    snapshot = np.array(data, copy=True) if copy else np.asarray(data)
+    future = MAFuture()
+    slot = zoo.net.reserve_collective_slot()
+
+    def run() -> None:
+        try:
+            future._set(zoo.net.sharded_average(snapshot, slot=slot))
+        except BaseException as exc:  # noqa: BLE001 - delivered to result()
+            future._set_error(exc)
+
+    try:
+        threading.Thread(target=run, daemon=True,
+                         name=f"mv-ma-shavg-r{zoo.net.rank}").start()
+    except BaseException:
+        # Serve the reserved ticket as a no-op before re-raising, or
+        # every later collective on this endpoint blocks forever.
+        zoo.net._run_collective(lambda: None, slot)
+        raise
+    return future
+
+
+class MAShardedAverager(MAAverager):
+    """Delta-vs-last-average MA over the sharded sparse collective.
+
+    ``MAAverager`` ships the FULL parameter buffer every round — dense
+    by construction, so the wire codec can never shrink it. This
+    variant keeps a reference copy of the last cross-rank average
+    (bit-identical on every rank, since it is rebuilt from collective
+    results) and ships only ``params - reference``: once training
+    localizes, most entries are exactly zero and the delta rides the
+    codec's sparse index+value streams through
+    ``net.sharded_average`` — reduce-scatter of sparse frames,
+    shard-local divide, allgather (docs/ALLREDUCE.md).
+
+    Round protocol (same call points as ``MAAverager``, so
+    ``MACorpusTrainer`` swaps it in unchanged and sync/overlap runs
+    stay bit-identical):
+
+        submit(params_i):  delta_i = params_i - ref   (ref None on the
+                           first round: the delta IS params_i and ref
+                           starts at the first average — dense once,
+                           exact regardless of how far replicas have
+                           already diverged)
+        collect(current):  ref += mean(delta)  (identical on all ranks)
+                           returns ref + (current - params_i)
+
+    Memory: one extra full-size reference buffer per rank (constant in
+    world size); the collective itself holds only a 1/world shard of
+    reduce state."""
+
+    def __init__(self, zoo=None):
+        super().__init__(zoo)
+        self._ref: Optional[np.ndarray] = None
+
+    def submit(self, data: np.ndarray) -> MAFuture:
+        if self._future is not None:
+            raise RuntimeError(
+                "MAShardedAverager: collect() the in-flight average "
+                "before submitting the next one (double-buffer depth "
+                "is 1)")
+        self._snapshot = np.array(data, dtype=np.float32, copy=True)
+        delta = self._snapshot if self._ref is None \
+            else self._snapshot - self._ref
+        # copy=False: the snapshot (and therefore the first-round
+        # delta) is already private to this averager, and a fresh
+        # ``snapshot - ref`` array is private too.
+        self._future = sharded_model_average_async(delta, self._zoo,
+                                                   copy=False)
+        return self._future
+
+    def collect(self, current: Optional[np.ndarray] = None,
+                timeout: Optional[float] = None) -> np.ndarray:
+        if self._future is None:
+            raise RuntimeError("MAShardedAverager: nothing submitted")
+        # Resolve BEFORE clearing state: a timeout must leave the
+        # averager busy and the reference untouched (peers WILL apply
+        # this round), so the caller can retry collect().
+        avg_delta = self._future.result(timeout=timeout)
+        snapshot = self._snapshot
+        self._future = None
+        self._snapshot = None
+        self._ref = avg_delta if self._ref is None \
+            else self._ref + avg_delta
+        if current is None:
+            # Copy: the reference must stay pristine — it is the
+            # shared baseline every rank's next delta subtracts.
+            return self._ref.copy()
+        return self._ref + (np.asarray(current) - snapshot)
+
+
 class MASGDStep:
     """Data-parallel SGD step over the device mesh.
 

@@ -15,7 +15,23 @@ collective stack:
   frames riding the transport's ``send_async`` writer threads, so round
   k's wire time overlaps round k+1's receive + reduce (SparCML-style
   chunking). Works for ANY rank count (no surplus fold), which is why
-  non-power-of-two worlds prefer it even at modest sizes.
+  non-power-of-two worlds prefer it even at modest sizes;
+- **sparse stream** (new): for sparse float32 sums (model-average
+  deltas are power-law sparse — SparCML, arxiv 1802.08021 / 1312.3020)
+  a direct reduce-scatter of codec sparse index+value frames — every
+  rank ships only its own nonzeros straight to each segment's owner,
+  so hop-by-hop fill-in never rides the wire — followed by a
+  single-encode ring allgather of the reduced segments. The owner
+  merges inbound index streams in rank order (union of indices, sum of
+  values, fill-in tracked per hop into ``SPARSE_FILL[*]``), which
+  reproduces the unchunked dense ring's fold association exactly:
+  lossless sparse results are bit-identical to the dense ring's.
+  ``choose_algo`` picks it from a cluster-agreed nnz probe and falls
+  back to the dense ring once the union density crosses the break-even
+  (``-allreduce_sparse_*``); ``sharded_average`` adds the cross-replica
+  sharded model-average step (arxiv 2004.13336): reduce-scatter,
+  shard-local divide, allgather — per-rank reduce-state is one segment
+  instead of the full buffer.
 
 Per-chunk segments >= 4 KB ride the wire codec; the opt-in
 ``-allreduce_lossy`` tier quantizes segment values (int8 / f16 via
@@ -46,7 +62,7 @@ from __future__ import annotations
 
 import collections
 import time
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -54,7 +70,9 @@ from ..core.blob import Blob
 from ..core.message import Message, MsgType, is_wire_encoded
 from ..util.configure import (define_bool, define_double, define_int,
                               define_string, get_flag)
-from ..util.wire_codec import (CODEC_SLOT, decode_blob, encode_blob,
+from ..util.dashboard import samples
+from ..util.wire_codec import (CODEC_SLOT, break_even_density, decode_blob,
+                               decode_blob_sparse, density_of, encode_blob,
                                worth_encoding)
 from .net import NetInterface
 
@@ -94,6 +112,20 @@ define_bool("allreduce_lossy", False,
             "error-feedback residuals carried across calls "
             "(EQuARX-style). Lossless when off — bit-identical to the "
             "unquantized path")
+define_double("allreduce_sparse_density", 0.25,
+              "auto algorithm choice: float32 sum-allreduces whose "
+              "cluster-agreed union density (sum of per-rank nnz / "
+              "element count, the nnz-probe upper bound on reduced "
+              "fill-in) sits at or below this take the sparse-stream "
+              "path; the effective cutoff is additionally clamped to "
+              "the codec break-even (-wire_codec_density) — past that "
+              "the reduced segments would ride RAW frames and the "
+              "index merge buys nothing")
+define_int("allreduce_sparse_idx_budget", 8388608,
+           "auto algorithm choice: cap on the union index count "
+           "(density x elements) the sparse path will carry per "
+           "collective — past it the per-index Python merge cost beats "
+           "the dense ring's streaming chunks even at low density")
 
 _SMALL_BYTES = 4096  # allgather-based path threshold (ref: engine.cpp:33)
 
@@ -115,10 +147,68 @@ _RH_RESULT = 2900        # surplus-rank final result
 _RING_RS_BASE = 100000   # ring reduce-scatter: base + step*nchunks + chunk
 _RING_AG_BASE = 550000   # ring allgather:     base + step*nchunks + chunk
 _RING_TAG_SPAN = 400000  # per-phase room; bounds (size-1)*nchunks
+_PROBE_BASE = 955000     # nnz-agreement allgather before an auto pick
+_SPARSE_RS_BASE = 960000  # sparse direct scatter: base + segment
+_SPARSE_AG_BASE = 1000000  # sparse allgather ring: base + step
 
 
 def _is_pow2(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
+
+
+def choose_algo(nbytes: int, n_elems: int, world: int, *,
+                density: Optional[float] = None,
+                reducer_is_add: bool = True, is_f32: bool = True,
+                forced: Optional[str] = None) -> str:
+    """THE algorithm decision — one documented function replacing the
+    scattered size checks (auto used to key on byte size only). Every
+    input is either cluster-identical by the collective contract
+    (payload shape/dtype, reducer, world, flags) or cluster-AGREED
+    (``density`` comes from the nnz probe round, the same value on
+    every rank), so every rank lands on the same branch — a split
+    decision would mismatch the wire protocol.
+
+    Order of precedence:
+
+    1. payloads under 4 KB, or with fewer elements than ranks, take the
+       Bruck allgather + local reduce ``small`` path regardless of any
+       forced algorithm (the reference's small-path contract);
+    2. a forced ``-allreduce_algo`` (ring / rhalving / sparse) wins;
+       forcing ``sparse`` for a non-additive reducer or a non-float32
+       payload falls back to the ring (the index-union merge is a SUM
+       over float32 codec streams, nothing else);
+    3. auto, sparse: float32 sum-reductions whose agreed union density
+       sits at or below min(``-allreduce_sparse_density``,
+       ``break_even_density()``) AND whose union index count
+       (density x elements) fits ``-allreduce_sparse_idx_budget`` take
+       the sparse-stream path — the measured fill-in signal, re-probed
+       every call, is exactly what switches a densifying workload back
+       to the dense ring;
+    4. auto, dense: at or above ``-allreduce_ring_kb`` the chunked
+       ring; non-power-of-two worlds switch to the ring from 16 KB (the
+       recursive-halving surplus fold costs two extra full-buffer
+       serial hops); everything else recursive halving.
+    """
+    if nbytes < _SMALL_BYTES or n_elems < world:
+        return "bruck"
+    algo = str(get_flag("allreduce_algo")) if forced is None else forced
+    if algo == "sparse":
+        return "sparse" if (reducer_is_add and is_f32) else "ring"
+    if algo in ("ring", "rhalving"):
+        return algo
+    if reducer_is_add and is_f32 and density is not None:
+        cutoff = min(float(get_flag("allreduce_sparse_density")),
+                     break_even_density())
+        if density <= cutoff and density * n_elems <= int(
+                get_flag("allreduce_sparse_idx_budget")):
+            return "sparse"
+    if nbytes >= int(get_flag("allreduce_ring_kb")) * 1024:
+        return "ring"
+    if not _is_pow2(world) and nbytes >= 4 * _SMALL_BYTES:
+        # Surplus fold pays 2 extra full-buffer serial hops; the
+        # ring needs no fold, so non-pow2 worlds switch early.
+        return "ring"
+    return "rhalving"
 
 
 class AllreduceEngine:
@@ -140,9 +230,21 @@ class AllreduceEngine:
         # rank runs this same engine. In-process transports move object
         # references — lossless encoding there only burns CPU (the
         # lossy tier still engages: its point is the quantization
-        # semantics, not the bytes).
+        # semantics, not the bytes). The SPARSE path frames regardless:
+        # the index+value stream is the representation its O(nnz) merge
+        # runs on, not just a wire shrink.
         self._codec = (not net.in_process
                        and bool(get_flag("wire_codec")))
+        #: Algorithm the last public collective ran
+        #: (bruck/ring/rhalving/sparse/sharded) — bench + tests read it.
+        self.last_algo: Optional[str] = None
+        #: Bytes of reduce-state this rank held during the last
+        #: collective: the buffer(s) that accumulate reduced values
+        #: before the allgather re-assembles the full result. The
+        #: sharded paths hold one SEGMENT (~1/world of the buffer);
+        #: the monolithic/ring paths hold the full flat copy; the
+        #: small path stacks `world` whole blocks.
+        self.last_reduce_state_bytes = 0
 
     # -- msg_id construction --
     def _mid(self, tag: int) -> int:
@@ -254,17 +356,30 @@ class AllreduceEngine:
         return self._recv(peer, tag, payload.dtype)
 
     # -- algorithm choice --
-    def _pick_algo(self, nbytes: int) -> str:
-        algo = str(get_flag("allreduce_algo"))
-        if algo in ("ring", "rhalving"):
-            return algo
-        if nbytes >= int(get_flag("allreduce_ring_kb")) * 1024:
-            return "ring"
-        if not _is_pow2(self.size) and nbytes >= 4 * _SMALL_BYTES:
-            # Surplus fold pays 2 extra full-buffer serial hops; the
-            # ring needs no fold, so non-pow2 worlds switch early.
-            return "ring"
-        return "rhalving"
+    def _probe_union_density(self, data: np.ndarray) -> float:
+        """Cluster-agreed density signal for ``choose_algo``: a tiny
+        Bruck allgather of each rank's nnz, reduced to
+        min(1, sum nnz / n) — the union upper bound on the reduced
+        result's fill-in (cancellation only shrinks it). Every rank
+        computes the identical value, so the dense-vs-sparse pick can
+        never split the cluster the way a LOCAL density test would
+        (rank 0 at 5.1%% picking dense while rank 1 at 4.9%% picks
+        sparse deadlocks the protocol)."""
+        nnz = int(np.count_nonzero(data))
+        parts = self._bruck_allgather(np.array([nnz], np.int64),
+                                      base=_PROBE_BASE)
+        total = sum(int(p[0]) for p in parts)
+        return min(1.0, total / max(data.size, 1))
+
+    def _should_probe(self, data: np.ndarray, reducer: Callable) -> bool:
+        # Rank-identical by the collective contract (same payload
+        # shape/dtype, same reducer, same flags everywhere): every rank
+        # either joins the probe round or skips it.
+        return (str(get_flag("allreduce_algo")) == "auto"
+                and reducer is np.add
+                and data.dtype == np.float32
+                and data.nbytes >= _SMALL_BYTES
+                and data.size >= self.size)
 
     # -- public API (ref: allreduce_engine.h:96-118) --
     def allreduce(self, data: np.ndarray,
@@ -273,29 +388,42 @@ class AllreduceEngine:
         if self.size == 1:
             return data.copy()
         self._next_gen()
-        if data.nbytes < _SMALL_BYTES or data.size < self.size:
+        density = self._probe_union_density(data) \
+            if self._should_probe(data, reducer) else None
+        algo = choose_algo(data.nbytes, data.size, self.size,
+                           density=density,
+                           reducer_is_add=reducer is np.add,
+                           is_f32=data.dtype == np.float32)
+        self.last_algo = algo
+        if algo == "bruck":
             # Small path: allgather everyone's buffer, reduce locally
             # (ref: allreduce_engine.cpp:34-43).
             stacked = self._bruck_allgather(data)
+            self.last_reduce_state_bytes = self.size * data.nbytes
             out = stacked[0]
             for part in stacked[1:]:
                 out = reducer(out, part)
             return out
-        if self._pick_algo(data.nbytes) == "ring":
+        if algo == "sparse":
+            return self._sparse_allreduce(data, density)
+        if algo == "ring":
+            self.last_reduce_state_bytes = data.nbytes
             return self._ring_allreduce(data, reducer)
+        self.last_reduce_state_bytes = data.nbytes
         return self._reduce_scatter_allgather(data, reducer)
 
     def allgather(self, data: np.ndarray) -> list:
         self._next_gen()
         return self._bruck_allgather(data)
 
-    def _bruck_allgather(self, data: np.ndarray) -> list:
+    def _bruck_allgather(self, data: np.ndarray,
+                         base: int = _BRUCK_BASE) -> list:
         """Bruck doubling allgather: after round k every rank holds 2^(k+1)
         blocks; blocks are sent to rank-2^k and received from rank+2^k
         (ref: allreduce_engine.cpp:90-117, allreduce_topo.cpp:20-37)."""
         n = self.size
         blocks = [np.asarray(data)]
-        tag = _BRUCK_BASE
+        tag = base
         distance = 1
         while distance < n:
             dst = (self.rank - distance) % n
@@ -425,6 +553,202 @@ class AllreduceEngine:
             while pending:
                 ag_recv(pending.popleft())
         return flat.reshape(shape)
+
+    # -- sparse-stream tier (SparCML-style index+value collectives) ----
+    def sharded_average(self, data: np.ndarray) -> np.ndarray:
+        """Cross-rank MEAN with sharded reduce state (arxiv
+        2004.13336's cross-replica sharding of the update step): direct
+        sparse reduce-scatter — each rank accumulates only the segment
+        it owns — then the divide applied SHARD-LOCALLY, then a
+        single-encode allgather that re-assembles the full averaged
+        buffer straight into the output. No rank ever holds more
+        reduce-state than one segment (~1/world of the buffer, reported
+        via ``last_reduce_state_bytes``), where the dense paths copy
+        and accumulate the whole flat buffer; see docs/ALLREDUCE.md
+        for the memory math. float32 only — this is the model-average
+        parameter path, and the sparse merge is an f32 sum.
+
+        Bit-identity: the segment fold order matches the UNCHUNKED
+        dense ring's, and the divide is the same elementwise op the
+        dense ``allreduce(x) / world`` path runs, so a lossless sharded
+        average equals ring-then-divide bit for bit (one chunk)."""
+        data = np.asarray(data)
+        if data.dtype != np.float32:
+            raise TypeError(
+                "sharded_average is float32-only (model-average "
+                f"parameters); got {data.dtype}")
+        if self.size == 1:
+            return data.copy()
+        self._next_gen()
+        self.last_algo = "sharded"
+        if data.nbytes < _SMALL_BYTES or data.size < self.size:
+            # Sharding a sub-4KB buffer buys nothing: small path.
+            stacked = self._bruck_allgather(data)
+            self.last_reduce_state_bytes = self.size * data.nbytes
+            out = stacked[0].copy()
+            for part in stacked[1:]:
+                out += part
+            out /= self.size
+            return out
+        samples("SPARSE_FILL[input]").add(density_of(data))
+        return self._sparse_collective(data, average=True)
+
+    def _sparse_allreduce(self, data: np.ndarray,
+                          density: Optional[float]) -> np.ndarray:
+        """Sum-allreduce over sparse index+value streams (same two
+        phases as ``sharded_average`` minus the divide)."""
+        if density is not None:
+            samples("SPARSE_FILL[input]").add(density)
+        return self._sparse_collective(np.asarray(data), average=False)
+
+    def _sparse_collective(self, data: np.ndarray,
+                           average: bool) -> np.ndarray:
+        """The sparse-tier driver both public forms share: direct
+        reduce-scatter, optional shard-local divide, single-encode
+        allgather into a fresh output buffer."""
+        shape = data.shape
+        flat = np.ascontiguousarray(data).reshape(-1)
+        bounds = np.linspace(0, flat.size,
+                             self.size + 1).astype(np.int64)
+        lossy = bool(get_flag("allreduce_lossy"))
+        acc = self._sparse_reduce_scatter(flat, bounds, lossy)
+        self.last_reduce_state_bytes = acc.nbytes
+        if average:
+            acc /= self.size  # the shard-local average
+        out = np.empty(flat.size, np.float32)
+        self._sparse_allgather(out, bounds, acc, lossy)
+        return out.reshape(shape)
+
+    def _post_segment(self, dst: int, payload: np.ndarray,
+                      tag: int) -> None:
+        """Sparse-tier lossless contribution send: codec-framed
+        whenever the sparse tier wins — even in-process, because the
+        index+value stream IS the representation the owner's O(nnz)
+        merge consumes — raw otherwise (``_send`` handles the
+        in-process snapshot copy)."""
+        payload = np.ascontiguousarray(payload)
+        if payload.nbytes >= _CODEC_MIN_BYTES and worth_encoding(payload):
+            frame, _ = encode_blob(payload)
+            self._post(dst, Blob(np.frombuffer(frame, np.uint8)), tag,
+                       True)
+        else:
+            self._send(dst, payload, tag)
+
+    def _merge_stream(self, acc: np.ndarray, blob: Blob,
+                      encoded: bool) -> None:
+        """Fold one inbound contribution into the owner's segment
+        accumulator: sparse frames through the index stream
+        (``acc[idx] += vals`` — codec indices are strictly increasing,
+        so the fancy-index add never collides with itself), raw / dense
+        tiers through a dense add. Elementwise this performs the same
+        additions the dense ring's fold would, so the lossless result
+        is bit-identical."""
+        if encoded:
+            idx, vals = decode_blob_sparse(np.asarray(blob.data))
+            if idx is None:
+                acc += vals.astype(np.float32, copy=False)
+            else:
+                acc[idx] += vals
+        else:
+            acc += blob.as_array(np.float32)
+
+    def _sparse_reduce_scatter(self, flat: np.ndarray,
+                               bounds: np.ndarray,
+                               lossy: bool) -> np.ndarray:
+        """Phase 1 of the sparse tier: DIRECT scatter. Each rank sends
+        its own contribution for segment s straight to s's owner as a
+        codec sparse frame — partial sums never ride the wire, so the
+        hop-by-hop fill-in growth a sparse RING would pay (the union
+        densifies every hop) costs bytes only once, in the allgather
+        of the fully-reduced segments. The owner then folds the n-1
+        inbound index streams plus its own slice IN RANK ORDER,
+        starting from the segment index — the same pairwise sums as
+        the unchunked dense ring's fold (operand order differs only
+        where IEEE-754 addition commutes), which is what makes the
+        lossless sparse path bit-identical to the dense ring. Rank r
+        owns segment (r+1) %% n, the dense ring's ownership map.
+        Fill-in after every folded stream lands on the
+        ``SPARSE_FILL[reduce]`` samples reservoir."""
+        n, r = self.size, self.rank
+        ef = self._ef_buffer("sprs", flat.size) if lossy else None
+        for off in range(1, n):
+            o = (r + off) % n  # stagger: rank 0 is not everyone's
+            s = (o + 1) % n    # first target
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            tag = _SPARSE_RS_BASE + s
+            if lossy:
+                self._send_lossy(o, flat, lo, hi, tag, ef)
+            else:
+                self._post_segment(o, flat[lo:hi], tag)
+        own = (r + 1) % n
+        lo, hi = int(bounds[own]), int(bounds[own + 1])
+        seglen = hi - lo
+        acc = np.zeros(seglen, np.float32)
+        fill = samples("SPARSE_FILL[reduce]")
+        for k in range(n):
+            src = (own + k) % n
+            if src == r:  # own slice folds last (r == own - 1 mod n)
+                acc += flat[lo:hi]
+            else:
+                blob, encoded = self._drain_until(
+                    src, _SPARSE_RS_BASE + own)
+                self._merge_stream(acc, blob, encoded)
+            fill.add(np.count_nonzero(acc) / max(seglen, 1))
+        return acc
+
+    def _sparse_allgather(self, out: np.ndarray, bounds: np.ndarray,
+                          acc: np.ndarray, lossy: bool) -> None:
+        """Phase 2 of the sparse tier: ring allgather of the reduced
+        (or reduced-and-averaged) segments with verbatim frame
+        forwarding. Each segment is encoded ONCE at its owner — as a
+        sparse stream while its measured fill-in stays below the codec
+        break-even, as a RAW frame past it (the automatic per-segment
+        dense switchover) — and relayed untouched, so every rank lands
+        on identical bytes, lossy tiers included."""
+        n, r = self.size, self.rank
+        right, left = (r + 1) % n, (r - 1) % n
+        own = (r + 1) % n
+        lo, hi = int(bounds[own]), int(bounds[own + 1])
+        if lossy:
+            ef = self._ef_buffer("spag", out.size)
+            vals = acc + ef[lo:hi]
+            if vals.nbytes >= _CODEC_MIN_BYTES:
+                frame, residual = encode_blob(vals, lossy=True)
+                ef[lo:hi] = residual if residual is not None else 0.0
+            else:  # sub-threshold: exact, pending residual consumed
+                frame, _ = encode_blob(vals)
+                ef[lo:hi] = 0.0
+            # decoded == vals - residual; every rank lands on this.
+            own_vals = vals - ef[lo:hi]
+            carry, encoded = Blob(np.frombuffer(frame, np.uint8)), True
+        elif acc.nbytes >= _CODEC_MIN_BYTES and worth_encoding(acc):
+            frame, _ = encode_blob(acc)
+            own_vals = acc
+            carry, encoded = Blob(np.frombuffer(frame, np.uint8)), True
+        else:
+            own_vals = acc
+            carry, encoded = Blob(acc), False
+        out[lo:hi] = own_vals
+        for step in range(n - 1):
+            tag = _SPARSE_AG_BASE + step
+            self._post(right, carry, tag, encoded)
+            blob, enc = self._drain_until(left, tag)
+            seg = (r - step) % n
+            slo, shi = int(bounds[seg]), int(bounds[seg + 1])
+            seg_out = out[slo:shi]
+            if enc:
+                # Scatter the index stream straight into the output
+                # slice — decode_blob would allocate a full segment
+                # temp just to copy it here.
+                idx, vals = decode_blob_sparse(np.asarray(blob.data))
+                if idx is None:
+                    seg_out[:] = vals
+                else:
+                    seg_out[:] = 0.0
+                    seg_out[idx] = vals
+            else:
+                seg_out[:] = blob.as_array(np.float32)
+            carry, encoded = blob, enc
 
     def _ef_buffer(self, phase: str, n: int) -> np.ndarray:
         buf = self._ef.get((phase, n))

@@ -156,6 +156,31 @@ class NetInterface:
 
         return self._run_collective(run, slot)
 
+    def sharded_average(self, array: "np.ndarray",
+                        slot: Optional[int] = None) -> "np.ndarray":
+        """Cross-rank MEAN with sharded reduce state: each rank
+        reduce-scatters sparse codec frames for the shard it owns,
+        divides that shard locally, and allgathers the averaged
+        segments (AllreduceEngine.sharded_average — the model-average
+        fast path; docs/ALLREDUCE.md). Same ma-mode contract and
+        per-endpoint FIFO ticketing as ``allreduce``: sharded averages
+        and allreduces issued on one endpoint are matched positionally
+        across ranks in call order."""
+        if getattr(self, "_recv_owned", False):
+            raise RuntimeError(
+                "transport-level sharded_average requires ma mode on "
+                "this transport: the PS actors own the endpoint's recv "
+                "stream (start with -ma=true, ref: src/net.cpp:27-35)")
+        from .allreduce_engine import AllreduceEngine
+
+        def run():
+            engine = getattr(self, "_allreduce_engine", None)
+            if engine is None:
+                engine = self._allreduce_engine = AllreduceEngine(self)
+            return engine.sharded_average(array)
+
+        return self._run_collective(run, slot)
+
     # -- per-endpoint collective FIFO --
     def _collective_fifo(self) -> dict:
         # Lazily created; the instance-dict setdefault is atomic under
@@ -301,3 +326,12 @@ class LocalNet(NetInterface):
     def allreduce(self, array, slot=None):
         return self._run_collective(
             lambda: self._fabric.allreduce(array, self._rank), slot)
+
+    def sharded_average(self, array, slot=None):
+        # Shared memory has no wire to save and no per-rank memory
+        # budget to shard (every virtual rank is one process): the
+        # native rank-ordered fabric sum + divide is the same
+        # deterministic math with none of the frame round trips.
+        return self._run_collective(
+            lambda: self._fabric.allreduce(array, self._rank)
+            / self.size, slot)

@@ -68,9 +68,12 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "allreduce_timeout_s": 120.0,
     "allreduce_stash_cap": 4096,
     "allreduce_lossy": False,
+    "allreduce_sparse_density": 0.25,
+    "allreduce_sparse_idx_budget": 8388608,
     # -- wire codec (util/wire_codec.py) --
     "wire_codec": True,
     "wire_codec_lossy": False,
+    "wire_codec_density": 0.5,
     # -- tables (tables/matrix_table.py, tables/client_cache.py) --
     "sparse_compress": True,
     "verify_device_ids": False,

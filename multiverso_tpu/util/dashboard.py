@@ -41,6 +41,10 @@ METRIC_NAMES: Dict[str, str] = {
     # -- model / collective stalls --
     "PS_GET_STALL": "trainer blocked on a parameter Get (prefetch miss)",
     "MA_COMM_STALL": "model-average blocked on the collective",
+    # -- sparse collective tier (runtime/allreduce_engine.py) --
+    "SPARSE_FILL[*]": "sparse collective fill-in: union density per "
+                      "merge hop ([reduce]) and probed input density "
+                      "([input])",
     # -- snapshotter --
     "SNAPSHOT_CAPTURE": "consistent state cut under the table lock",
     "SNAPSHOT_WRITE": "snapshot serialize+write off the lock",

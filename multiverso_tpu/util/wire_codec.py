@@ -54,7 +54,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from .configure import define_bool
+from .configure import define_bool, define_double, get_flag
 
 define_bool("wire_codec", True,
             "advertise + apply the compact wire codec on cross-process "
@@ -64,6 +64,15 @@ define_bool("wire_codec_lossy", False,
             "allow the int8/fp16 value tiers for sparse matrix Add "
             "traffic, with worker-side error-feedback residuals "
             "(pulls stay lossless)")
+define_double("wire_codec_density", 0.5,
+              "break-even density for the LOSSLESS sparse tier: float32 "
+              "payloads whose nonzero fraction sits below this ride "
+              "sparse index+value streams; denser ones pass through "
+              "RAW. 0.5 is the wire-cost break-even for the worst-case "
+              "absolute-int32 index stream (8 B/pair vs 4 B/element); "
+              "lower it when encode CPU dominates a fast local wire, "
+              "raise it (toward ~0.67) when the u16-gap stream (6 "
+              "B/pair) is known to engage")
 
 MAGIC = b"MV"
 VERSION = 1
@@ -302,52 +311,87 @@ def _as_bytes(data) -> memoryview:
     return memoryview(data)
 
 
-def decode_blob(data) -> np.ndarray:
-    """Decode one codec frame back to a flat array of its original dtype."""
-    buf = _as_bytes(data)
+def _validated_header(buf) -> Tuple[int, int, int, int, int, int]:
+    """Unpack + validate one frame header; the single unpack site both
+    decode paths share. Returns (tier, dcode, idx_enc, chunk, n, nnz)."""
     magic, version, tier, dcode, idx_enc, chunk, n, nnz = \
         HEADER.unpack_from(buf, 0)
     if magic != MAGIC:
         raise ValueError("wire codec: bad magic (not a codec frame)")
     if version != VERSION:
         raise ValueError(f"wire codec: unsupported version {version}")
+    return tier, dcode, idx_enc, chunk, n, nnz
+
+
+def decode_blob_sparse(data) -> Tuple[Optional[np.ndarray], np.ndarray]:
+    """Sparse-stream view of one codec frame: ``(idx, vals)``.
+
+    For the sparse tiers ``idx`` is the int64 index vector and ``vals``
+    the float32 values, one per index — WITHOUT materializing the dense
+    array. This is the collective merge path: an owner folds
+    ``acc[idx] += vals`` in O(nnz) per incoming stream instead of the
+    O(n) a dense decode + dense add would cost. For RAW / dense tiers
+    ``idx`` is None and ``vals`` is the full flat payload (RAW keeps its
+    original dtype; dense lossy tiers dequantize to float32).
+    ``vals`` may be a read-only view into the frame buffer — callers
+    must not mutate it (``decode_blob`` copies where its contract needs
+    ownership)."""
+    buf = _as_bytes(data)
+    tier, dcode, idx_enc, chunk, n, nnz = _validated_header(buf)
+    return _decode_streams(buf, tier, dcode, idx_enc, chunk, n, nnz)
+
+
+def _decode_streams(buf, tier, dcode, idx_enc, chunk, n,
+                    nnz) -> Tuple[Optional[np.ndarray], np.ndarray]:
     body = buf[HEADER_BYTES:]
     dtype = _DTYPES[dcode]
     if tier == RAW:
-        return np.frombuffer(body, dtype, n).copy()
-    if tier in (SPARSE_F32, SPARSE_F16, SPARSE_I8):
-        if idx_enc == IDX_GAP16:
-            first = int(np.frombuffer(body, np.uint32, 1)[0])
-            gaps = np.frombuffer(body, np.uint16, nnz - 1, 4)
-            idx = np.empty(nnz, np.int64)
-            idx[0] = first
-            idx[1:] = first + np.cumsum(gaps.astype(np.int64))
-            off = 4 + 2 * (nnz - 1)
-        else:
-            idx = np.frombuffer(body, np.int32, nnz)
-            off = nnz * 4
-        if tier == SPARSE_F32:
-            vals = np.frombuffer(body, np.float32, nnz, off)
-        elif tier == SPARSE_F16:
-            vals = np.frombuffer(body, np.float16, nnz, off) \
-                .astype(np.float32)
-        else:
-            nchunks = max((nnz + chunk - 1) // chunk, 1)
-            scales = np.frombuffer(body, np.float32, nchunks, off)
-            q = np.frombuffer(body, np.int8, nnz, off + nchunks * 4)
-            vals = _dequantize_i8(q, scales, chunk)
-        full = np.zeros(n, np.float32)
-        full[idx] = vals
-        return full.astype(dtype, copy=False)
+        return None, np.frombuffer(body, dtype, n)
     if tier == DENSE_F16:
-        return np.frombuffer(body, np.float16, n).astype(np.float32) \
-            .astype(dtype, copy=False)
+        return None, np.frombuffer(body, np.float16, n).astype(np.float32)
     if tier == DENSE_I8:
         nchunks = max((n + chunk - 1) // chunk, 1)
         scales = np.frombuffer(body, np.float32, nchunks)
         q = np.frombuffer(body, np.int8, n, nchunks * 4)
-        return _dequantize_i8(q, scales, chunk).astype(dtype, copy=False)
-    raise ValueError(f"wire codec: unknown tier {tier}")
+        return None, _dequantize_i8(q, scales, chunk)
+    if tier not in (SPARSE_F32, SPARSE_F16, SPARSE_I8):
+        raise ValueError(f"wire codec: unknown tier {tier}")
+    if idx_enc == IDX_GAP16:
+        first = int(np.frombuffer(body, np.uint32, 1)[0])
+        gaps = np.frombuffer(body, np.uint16, nnz - 1, 4)
+        idx = np.empty(nnz, np.int64)
+        idx[0] = first
+        idx[1:] = first + np.cumsum(gaps.astype(np.int64))
+        off = 4 + 2 * (nnz - 1)
+    else:
+        idx = np.frombuffer(body, np.int32, nnz)
+        off = nnz * 4
+    if tier == SPARSE_F32:
+        vals = np.frombuffer(body, np.float32, nnz, off)
+    elif tier == SPARSE_F16:
+        vals = np.frombuffer(body, np.float16, nnz, off) \
+            .astype(np.float32)
+    else:
+        nchunks = max((nnz + chunk - 1) // chunk, 1)
+        scales = np.frombuffer(body, np.float32, nchunks, off)
+        q = np.frombuffer(body, np.int8, nnz, off + nchunks * 4)
+        vals = _dequantize_i8(q, scales, chunk)
+    return idx, vals
+
+
+def decode_blob(data) -> np.ndarray:
+    """Decode one codec frame back to a flat array of its original dtype."""
+    buf = _as_bytes(data)
+    tier, dcode, idx_enc, chunk, n, nnz = _validated_header(buf)
+    idx, vals = _decode_streams(buf, tier, dcode, idx_enc, chunk, n, nnz)
+    dtype = _DTYPES[dcode]
+    if idx is None:
+        if tier == RAW:
+            return vals.copy()  # the caller owns its decoded array
+        return vals.astype(dtype, copy=False)
+    full = np.zeros(n, np.float32)
+    full[idx] = vals
+    return full.astype(dtype, copy=False)
 
 
 # ---------------------------------------------------------------------------
@@ -359,17 +403,37 @@ def decode_blob(data) -> np.ndarray:
 MIN_ENCODE_BYTES = 1024
 
 
+def density_of(arr) -> float:
+    """Nonzero fraction of a host array (0.0 for an empty one) — one
+    cheap count_nonzero pass, the signal every sparse-vs-dense decision
+    in the tree keys on (this filter gate, the allreduce engine's
+    ``choose_algo``)."""
+    arr = np.asarray(arr)
+    if arr.size == 0:
+        return 0.0
+    return float(np.count_nonzero(arr)) / arr.size
+
+
+def break_even_density() -> float:
+    """Density below which the LOSSLESS sparse tier beats RAW, as a
+    wire-cost model: worst case the index stream is absolute int32
+    (8 B/pair vs 4 B/element raw → 0.5); the common power-law case
+    lands the u16-gap stream (6 B/pair → ~0.67). ``-wire_codec_density``
+    (default 0.5, the conservative bound) is the canonical knob — the
+    allreduce engine's sparse-tier switchover clamps its own cutoff to
+    this value, so one flag moves every break-even decision."""
+    return float(get_flag("wire_codec_density"))
+
+
 def worth_encoding(arr: np.ndarray) -> bool:
     """Would the LOSSLESS codec actually shrink this host array? Only
     float32 payloads can land in a sub-RAW tier, and sparsity must pay
-    for the worst-case index stream (absolute int32: 8 B/pair) plus
-    the header. One cheap count_nonzero pass here spares dense traffic
-    the full frame-copy round trip (encode + decode) that a RAW frame
-    would cost for -24 bytes of 'savings'."""
+    for the index stream (``break_even_density``). The density pass
+    spares dense traffic the full frame-copy round trip (encode +
+    decode) that a RAW frame would cost for -24 bytes of 'savings'."""
     if arr.dtype != np.float32 or arr.nbytes < MIN_ENCODE_BYTES:
         return False
-    nnz = int(np.count_nonzero(arr))
-    return nnz * 8 + HEADER_BYTES < arr.nbytes
+    return density_of(arr) < break_even_density()
 
 
 def _compressible(blob) -> bool:

@@ -345,3 +345,66 @@ class TestBatchAddFraming:
         np.testing.assert_array_equal(
             out[2].data[1].as_array(np.float32),
             _power_law_blob(1 << 13, 50, seed=2))
+
+
+class TestSparseStreamHelpers:
+    """decode_blob_sparse + the public density/break-even helpers the
+    sparse collective tier rides (docs/ALLREDUCE.md break-even model)."""
+
+    def test_sparse_frame_streams_without_densifying(self):
+        blob = _power_law_blob(1 << 16, 1 << 11, seed=3)
+        frame, _ = wc.encode_blob(blob)
+        assert wc.peek_tier(frame) in (wc.SPARSE_F32,)
+        idx, vals = wc.decode_blob_sparse(frame)
+        assert idx is not None
+        ref_idx = np.nonzero(blob)[0]
+        np.testing.assert_array_equal(np.asarray(idx), ref_idx)
+        np.testing.assert_array_equal(np.asarray(vals), blob[ref_idx])
+        # scatter-rebuild equals the dense decode
+        full = np.zeros(blob.size, np.float32)
+        full[idx] = vals
+        np.testing.assert_array_equal(full, wc.decode_blob(frame))
+
+    def test_dense_and_raw_frames_stream_as_dense(self):
+        dense = np.ones(2000, np.float32)
+        frame, _ = wc.encode_blob(dense)
+        idx, vals = wc.decode_blob_sparse(frame)
+        assert idx is None
+        np.testing.assert_array_equal(np.asarray(vals), dense)
+        ints = np.arange(100, dtype=np.int64)
+        frame, _ = wc.encode_blob(ints)
+        idx, vals = wc.decode_blob_sparse(frame)
+        assert idx is None and vals.dtype == np.int64
+        np.testing.assert_array_equal(np.asarray(vals), ints)
+
+    def test_lossy_sparse_frame_streams(self):
+        blob = _power_law_blob(1 << 16, 1 << 11, seed=5)
+        frame, residual = wc.encode_blob(blob, lossy=True)
+        idx, vals = wc.decode_blob_sparse(frame)
+        assert idx is not None
+        full = np.zeros(blob.size, np.float32)
+        full[idx] = vals
+        np.testing.assert_allclose(full + residual, blob, atol=1e-5)
+
+    def test_density_of(self):
+        x = np.zeros(1000, np.float32)
+        assert wc.density_of(x) == 0.0
+        x[:250] = 1.0
+        assert wc.density_of(x) == 0.25
+        assert wc.density_of(np.zeros(0, np.float32)) == 0.0
+
+    def test_break_even_density_flag_driven(self):
+        from multiverso_tpu.util.configure import set_flag
+        assert wc.break_even_density() == 0.5
+        blob = np.zeros(4096, np.float32)
+        blob[: 4096 * 2 // 5] = 1.0  # density 0.4
+        assert wc.worth_encoding(blob)
+        set_flag("wire_codec_density", 0.3)
+        assert wc.break_even_density() == 0.3
+        assert not wc.worth_encoding(blob)
+
+    def test_worth_encoding_gates(self):
+        # non-f32 and sub-1KB payloads never encode, any density
+        assert not wc.worth_encoding(np.zeros(4096, np.float64))
+        assert not wc.worth_encoding(np.zeros(64, np.float32))
+        assert wc.worth_encoding(np.zeros(4096, np.float32))
