@@ -780,6 +780,159 @@ def run_ps_two_servers(prebuilt=None, tmp: str = None,
             "pace_mbps": 8.0, "replica_hot_rows": 256}
 
 
+_ELASTIC_CHILD = r"""
+import os, sys, time, json
+import faulthandler
+faulthandler.dump_traceback_later(360, exit=True)
+import jax
+jax.config.update('jax_platforms', 'cpu')
+sys.path.insert(0, {repo!r})
+import numpy as np
+import multiverso_tpu as mv
+rank = int(sys.argv[1]); n = int(sys.argv[2])
+role = 'worker' if rank == 0 else 'server'
+mv.init(['-machine_file=' + {mf!r}, '-rank=' + str(rank),
+         '-ps_role=' + role, '-net_pace_mbps={pace}',
+         '-shard_initial_servers=2', '-reshard_chunk_rows=256',
+         '-heartbeat_interval_s=0.5', '-heartbeat_timeout_s=5',
+         '-rpc_retry_max=8', '-rpc_backoff_ms=50'])
+table = mv.create_matrix_table({rows}, {cols})
+if rank != 0:
+    # Servers idle until the worker's goodbye barrier.
+    mv.barrier()
+    mv.shutdown()
+    sys.exit(0)
+rng = np.random.default_rng(7)
+expect = rng.standard_normal(({rows}, {cols})).astype(np.float32)
+table.add(expect.copy())
+shadow = expect
+
+
+def window(label, seconds, reshard_to=None):
+    '''Drive row Gets (verified element-wise) for a timed window;
+    reshard_to fires MID-window so the transition itself is measured
+    inside the window it claims to improve.'''
+    t0 = time.perf_counter()
+    rows_served = 0
+    failed = wrong = 0
+    resharded = reshard_to is None
+    add_tick = 0
+    while time.perf_counter() - t0 < seconds:
+        if not resharded and time.perf_counter() - t0 > 1.0:
+            resharded = True
+            mv.current_zoo().reshard_table(table, reshard_to,
+                                           wait_s=0)
+        ids = np.sort(rng.choice({rows}, size={get_rows},
+                                 replace=False)).astype(np.int32)
+        try:
+            got = table.get_rows(ids)
+            if not np.allclose(got, shadow[ids], atol=1e-5):
+                wrong += 1
+            rows_served += ids.size
+        except Exception:
+            failed += 1
+        add_tick += 1
+        if add_tick % 16 == 0:
+            # A few writes keep the dual-write window honest.
+            aid = np.sort(rng.choice({rows}, size=8,
+                                     replace=False)).astype(np.int32)
+            d = np.ones((8, {cols}), np.float32) * 0.001
+            try:
+                table.add_rows(aid, d)
+                shadow[aid] += d
+            except Exception:
+                failed += 1
+    dt = time.perf_counter() - t0
+    return dict(label=label, rows_per_s=round(rows_served / dt, 1),
+                failed=failed, wrong=wrong,
+                owners=table.shard_owner_sids(),
+                epoch=table.shard_epoch())
+
+
+out = []
+out.append(window('w1_two_servers', {window_s}))
+out.append(window('w2_grown', {window_s} + 8.0,
+                  reshard_to=[0, 1, 2]))
+out.append(window('w3_grown_steady', {window_s}))
+out.append(window('w4_drained', {window_s} + 8.0,
+                  reshard_to=[0, 1]))
+faulthandler.cancel_dump_traceback_later()
+print('ELASTICRES', json.dumps(out), flush=True)
+mv.barrier()
+mv.shutdown()
+"""
+
+
+def run_elastic(tmp: str = None) -> dict:
+    """Elastic-resharding phase (ISSUE 12 acceptance,
+    docs/SHARDING.md): 1 pure worker + 3 server processes on a paced
+    localhost TCP mesh (8 Mbps per endpoint — each server owns its
+    emulated DCN link). The table starts on 2 servers
+    (-shard_initial_servers=2, server 2 a standby); mid-run the worker
+    grows it onto all three with LIVE row migration and later drains
+    back — while every read is verified element-wise against a shadow
+    model. Acceptance: the grown steady-state moves more rows/s than
+    the 2-server window (one extra paced link's worth), the drain
+    converges back, and ZERO requests fail or return wrong values
+    across both transitions."""
+    if tmp is None:
+        tmp = tempfile.mkdtemp(prefix="mv_elastic_")
+    from multiverso_tpu.util.net_util import free_listen_port
+    n = 4
+    mf = os.path.join(tmp, "elastic_mf.txt")
+    with open(mf, "w") as f:
+        for p in [free_listen_port() for _ in range(n)]:
+            f.write(f"127.0.0.1:{p}\n")
+    code = _ELASTIC_CHILD.format(
+        repo=os.path.dirname(os.path.abspath(__file__)), mf=mf,
+        pace=8.0, rows=1024, cols=256, get_rows=64, window_s=6.0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, str(rank), str(n)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for rank in range(n)]
+    windows = None
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            if p.returncode:
+                raise RuntimeError(
+                    f"elastic child failed: {err[-400:]}")
+            for line in out.splitlines():
+                if line.startswith("ELASTICRES "):
+                    windows = json.loads(line[11:])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    if windows is None:
+        raise RuntimeError("elastic worker never reported")
+    by = {w["label"]: w for w in windows}
+    failed = sum(w["failed"] for w in windows)
+    wrong = sum(w["wrong"] for w in windows)
+    grow_ratio = round(by["w3_grown_steady"]["rows_per_s"]
+                       / max(by["w1_two_servers"]["rows_per_s"], 1e-9),
+                       3)
+    drain_ratio = round(by["w4_drained"]["rows_per_s"]
+                        / max(by["w1_two_servers"]["rows_per_s"],
+                              1e-9), 3)
+    return {
+        "windows": windows,
+        "failed_requests": failed,
+        "wrong_values": wrong,
+        "grown_vs_two_servers": grow_ratio,
+        "drained_vs_two_servers": drain_ratio,
+        "grown_owner_sids": by["w3_grown_steady"]["owners"],
+        "drained_owner_sids": by["w4_drained"]["owners"],
+        # Acceptance: more links = more rows/s, zero failed/wrong
+        # requests across both live transitions.
+        "accept_grow_speedup": grow_ratio >= 1.15,
+        "accept_zero_failed": failed == 0 and wrong == 0,
+        "pace_mbps": 8.0,
+    }
+
+
 _TCP_CHILD = r"""
 import os, sys, time, json
 import faulthandler
@@ -2351,7 +2504,7 @@ _PHASE_EST = {
     "tcp_one_process": 65, "tcp_two_process": 110,
     "matrix_bandwidth": 60, "local_retime": 60,
     "wire_codec": 15, "client_cache": 45, "allreduce": 260,
-    "observability": 60,
+    "observability": 60, "elastic": 110,
 }
 
 
@@ -2632,6 +2785,10 @@ def main() -> None:
         result.merge(ps_two_servers=two_servers,
                      ps_two_servers_vs_single=two_servers.get(
                          "vs_single_same_window"))
+
+    elastic = result.run("elastic", run_elastic, tmp)
+    if elastic:
+        result.merge(elastic=elastic)
 
     cache = result.run("client_cache", run_client_cache)
     if cache:

@@ -69,6 +69,23 @@ echo "== sharding subset (routing equivalence / hot-shard replication) =="
 # (tests/test_sharding.py; docs/SHARDING.md).
 python -m pytest tests/test_sharding.py -x -q
 
+echo "== resharding subset (elastic shard maps / live migration) =="
+# Elastic-resharding invariants get their own named gate: shard-map
+# algebra (epoch-0 equivalence to the frozen layout, move/coalesce,
+# planning), the migration state machines (dirty re-streaming, seq-gap
+# retransmits), mid-stream 1-vs-N equivalence across a live grow/
+# shrink for matrix + KV with array/sparse siblings, the
+# no-version-regression handoff property, the unsupported-table NACK
+# rollback, and the in-process controller-partition chaos case
+# (tests/test_resharding.py; docs/SHARDING.md "Elastic resharding").
+# The SIGKILL chaos matrix (kill the migration source / destination
+# mid-handoff) is subprocess-heavy and lives behind -m slow.
+python -m pytest tests/test_resharding.py -x -q -m 'not slow'
+if [ "${MV_CI_SLOW:-0}" = "1" ]; then
+    echo "== slow chaos matrix (kill source / kill dest mid-handoff) =="
+    python -m pytest tests/test_resharding.py -x -q -m slow
+fi
+
 echo "== obs subset (tracing / metrics export / scrape surface) =="
 # Observability invariants get their own named gate: trace-id sampling
 # and wire propagation (TRACE_SLOT, byte-identity when off), the span

@@ -51,6 +51,16 @@ def barrier() -> None:
     current_zoo().barrier()
 
 
+def reshard_table(worker_table, server_ids,
+                  wait_s: float = 60.0) -> None:
+    """Respread a table over exactly ``server_ids`` with live row
+    migration (grow onto standby servers / drain a retiring one) —
+    traffic keeps flowing throughout (docs/SHARDING.md elastic
+    resharding)."""
+    current_zoo().reshard_table(worker_table, server_ids,
+                                wait_s=wait_s)
+
+
 def serve_table(name: str, worker_table, vocab=None) -> None:
     """Expose a worker table on this rank's online serving frontend
     (``-serving_port``, docs/SERVING.md) under ``/v1/tables/<name>``;

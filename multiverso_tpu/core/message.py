@@ -33,6 +33,27 @@ class MsgType(enum.IntEnum):
     # no requester waiter exists, so no reply type pairs with it
     # (value inside the server-bound request band).
     Request_ReplicaSync = 4
+    # Live elastic resharding (extension, docs/SHARDING.md "Elastic
+    # resharding"): all in the server-bound request band so they route
+    # to the server actor. ShardData streams a migrating range's rows
+    # source→destination (seq-numbered chunks; the FINAL chunk flips
+    # the source into its dual-read/forwarding window); ShardAck is
+    # the destination's retransmit request for seqs lost in flight;
+    # ShardBegin/ShardAbort are the controller's move start/rollback
+    # orders; FwdGet is a source-forwarded Get whose piggybacked
+    # source-served rows ride the reply as a REPLICA_SLOT group
+    # attributed to the source shard (the PR-7 reply contract reused
+    # verbatim — no new reply format).
+    Request_ShardData = 5
+    Request_ShardAck = 6
+    Request_ShardBegin = 7
+    Request_ShardAbort = 8
+    Request_FwdGet = 9
+    Request_FwdAdd = 10
+    #: LOCAL-ONLY (server actor self-nudge, never on the wire): stream
+    #: the next migration chunk, then re-enqueue — serving traffic
+    #: interleaves between chunks.
+    Server_Shard_Pump = 30
     Reply_Get = -1
     Reply_Add = -2
     Reply_BatchAdd = -3
@@ -69,6 +90,22 @@ class MsgType(enum.IntEnum):
     # controller every -metrics_interval_s. Controller band (>32),
     # fire-and-forget — no reply type pairs with it.
     Control_Metrics = 38
+    # Elastic-resharding control plane (docs/SHARDING.md): the
+    # migration destination commits (or refuses) a move toward the
+    # controller (Shard_Done, re-announced on traffic until the
+    # committed map broadcast confirms it landed); applications ask
+    # for a respread (Shard_Request, fire-and-forget — callers poll
+    # the table's adopted epoch); the controller broadcasts the
+    # epoch-stamped map (Shard_Map, below the worker band and
+    # intercepted BY NAME in the communicator like
+    # Control_Replica_Map — cloned to the worker AND server actors).
+    # Shard_Tick is LOCAL-ONLY (HeartbeatMonitor -> controller actor,
+    # never on the wire): re-send a possibly-lost Begin, re-broadcast
+    # maps, check the in-flight move against declared-dead ranks.
+    Control_Shard_Done = 39
+    Control_Shard_Request = 40
+    Control_Shard_Tick = 41
+    Control_Shard_Map = -39
 
 HEADER_SIZE = 10  # ints (8 in the reference; slot 8 added for
 #                   replication, slot 9 for request tracing)

@@ -20,6 +20,7 @@ from typing import Optional
 import numpy as np
 
 from ..core.blob import Blob
+from ..util import chaos
 from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             is_controller_bound, is_server_bound,
                             is_wire_encoded, is_worker_bound, mark_error,
@@ -248,7 +249,18 @@ class Communicator(Actor):
         """Outbound tail shared by the actor thread and the dispatch
         queue threads: settle in-process device payloads, run the codec
         filter for capable peers, send, and route any transport failure
-        into the synthesized-error path."""
+        into the synthesized-error path. The chaos harness's frame
+        faults (-chaos_frames, util/chaos.py) hook HERE — one
+        message-level choke point for every communicator-routed frame
+        on either transport; a dropped frame counts as sent."""
+        faulted = chaos.filter_frames(msg)
+        if faulted is not None:
+            for m in faulted:
+                self._encode_and_send_real(m)
+            return
+        self._encode_and_send_real(msg)
+
+    def _encode_and_send_real(self, msg: Message) -> None:
         if self._net.in_process and self._net.size > 1 \
                 and any(b.on_device for b in msg.data):
             # Materialize device payloads BEFORE they cross into a
@@ -298,6 +310,28 @@ class Communicator(Actor):
                 self._zoo.route(actors.SERVER, msg)
             return
         reason = f"{PEER_LOST_MARK} rank {msg.dst} unreachable: {exc}"
+        if msg.type_int in (int(MsgType.Request_FwdGet),
+                            int(MsgType.Request_FwdAdd)):
+            # A FORWARDED request's requester lives on another rank
+            # (this rank relayed it into a dual-read window,
+            # docs/SHARDING.md): synthesize the retryable error toward
+            # THAT rank's worker, and report the dead destination so
+            # the controller's monitor rolls the move back.
+            reply_type = MsgType.Reply_Get \
+                if msg.type_int == int(MsgType.Request_FwdGet) \
+                else MsgType.Reply_Add
+            if msg.msg_id >= 0:
+                reply = Message(src=self._zoo.rank, dst=msg.src,
+                                msg_type=reply_type,
+                                table_id=msg.table_id,
+                                msg_id=msg.msg_id)
+                mark_error(reply, RuntimeError(reason))
+                if reply.dst != self._zoo.rank:
+                    self._dispatch(reply)
+                else:
+                    self._local_forward(reply)
+            self._zoo.peer_lost(msg.dst, f"send failed: {exc}")
+            return
         reply = self._synth_error_reply(msg, reason)
         if reply is not None:
             self._local_forward(reply)
@@ -394,6 +428,19 @@ class Communicator(Actor):
                 else -1
             self._zoo.peer_lost(dead, "declared dead by the controller's "
                                       "liveness monitor")
+            return
+        if msg_type == int(MsgType.Control_Shard_Map):
+            # Epoch-stamped shard-map broadcast (elastic resharding,
+            # docs/SHARDING.md): the worker's tables re-route, the
+            # server's tables commit/prune migration state — cloned to
+            # each actor like Control_Replica_Map below.
+            for name in (actors.WORKER, actors.SERVER):
+                if self._zoo._actors.get(name) is not None:
+                    copy = Message(src=msg.src, dst=msg.dst,
+                                   msg_type=MsgType.Control_Shard_Map,
+                                   table_id=msg.table_id)
+                    copy.data = list(msg.data)
+                    self._zoo.route(name, copy)
             return
         if msg_type == int(MsgType.Control_Replica_Map):
             # Promoted-row map broadcast: both sides of this rank need

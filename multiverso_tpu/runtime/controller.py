@@ -104,6 +104,61 @@ class Controller(Actor):
         self.metrics = metrics_mod.ClusterMetrics()
         self.register_handler(MsgType.Control_Metrics,
                               self._process_metrics)
+        # Live elastic resharding (runtime/shard_map.py,
+        # docs/SHARDING.md): the controller owns the authoritative
+        # per-table shard maps, drives one migration at a time, and
+        # rolls back on endpoint death.
+        from . import shard_map as shard_map_mod
+        self.reshards = shard_map_mod.ReshardManager(zoo)
+        self.register_handler(MsgType.Control_Shard_Done,
+                              self._process_shard_done)
+        self.register_handler(MsgType.Control_Shard_Request,
+                              self._process_shard_request)
+        self.register_handler(MsgType.Control_Shard_Tick,
+                              self._process_shard_tick)
+
+    def _process_shard_done(self, msg: Message) -> None:
+        self._note_alive(msg.src)
+        desc = msg.data[0].as_array(np.int64)
+        self.reshards.on_done(msg.table_id, int(desc[0]),
+                              bool(int(desc[1])))
+
+    def _process_shard_request(self, msg: Message) -> None:
+        """An application asked for a table respread
+        (Zoo.reshard_table): blob = int64 [num_items, kind,
+        active server ids...]."""
+        self._note_alive(msg.src)
+        desc = msg.data[0].as_array(np.int64)
+        num_items, kind = int(desc[0]), int(desc[1])
+        active = [int(s) for s in desc[2:]]
+        if kind == 1:
+            # KV tables' frozen layout is the modulo bucket spread —
+            # seed the map accordingly before planning
+            # (tables/kv_table.py).
+            from . import shard_map as shard_map_mod
+            import numpy as _np
+            if msg.table_id not in self.reshards.maps:
+                a = shard_map_mod.initial_active_servers(
+                    self._zoo.num_servers)
+                bounds = _np.arange(num_items + 1, dtype=_np.int64)
+                owners = _np.arange(num_items, dtype=_np.int64) \
+                    % max(a, 1)
+                self.reshards.maps[msg.table_id] = \
+                    shard_map_mod.ShardMap(bounds, owners, epoch=0)
+        self.reshards.request(msg.table_id, num_items, active)
+        # Even a zero-move plan broadcasts the current map, so the
+        # requester's epoch poll completes.
+        self.reshards.broadcast(msg.table_id)
+
+    def _process_shard_tick(self, msg: Message) -> None:
+        """HeartbeatMonitor nudge (actor thread owns the reshard
+        state): abort the in-flight move if an endpoint died, re-send
+        a possibly-lost Begin, re-broadcast maps."""
+        with self._live_lock:
+            dead = list(self._declared_dead)
+        for rank in dead:
+            self.reshards.on_peer_dead(rank)
+        self.reshards.tick()
 
     def _process_metrics(self, msg: Message) -> None:
         """A rank's periodic metrics snapshot (fire-and-forget; also
@@ -186,11 +241,21 @@ class Controller(Actor):
             return
         rows = msg.data[0].as_array(np.int32)
         counts = msg.data[1].as_array(np.int32)
+        # The same load windows feed the -reshard_auto skew planner
+        # (runtime/shard_map.py): blob 2, when present, names the
+        # table's row space and the reporting shard.
+        num_items, sid = -1, self._zoo.rank_to_server_id(msg.src)
+        if len(msg.data) >= 3:
+            extra = msg.data[2].as_array(np.int64)
+            num_items, sid = int(extra[0]), int(extra[1])
+        self.reshards.note_report(msg.table_id, sid, rows, counts,
+                                  num_items=num_items)
         if not self._replicas.ingest(msg.table_id, rows, counts,
                                      reporter=msg.src):
             return
-        blobs = replica_mod.pack_replica_map(self._replicas.epoch,
-                                             self._replicas.promoted)
+        blobs = replica_mod.pack_replica_map(
+            self._replicas.epoch, self._replicas.promoted,
+            alive_sids=self.reshards.alive_sids())
         log.info("controller: replica map epoch %d (%s)",
                  self._replicas.epoch,
                  {t: int(r.size)
@@ -267,6 +332,11 @@ class Controller(Actor):
             reply.push(Blob(counts.copy()))
             reply.push(Blob(caps.copy()))
             self.send_to(actors.COMMUNICATOR, reply)
+            # Re-anchor the rejoined rank (and any lagging worker) on
+            # the CURRENT shard maps: its snapshot restored the
+            # elastic state it had, but only the controller knows the
+            # live epoch (docs/SHARDING.md rejoin-into-the-right-map).
+            self.reshards.broadcast_all()
             return
         self._register_waiting.append(msg)
         if len(self._register_waiting) != self._zoo.net_size:
@@ -424,3 +494,9 @@ class HeartbeatMonitor:
             controller.receive(Message(
                 src=zoo.rank, dst=zoo.rank,
                 msg_type=MsgType.Control_Check_Barriers))
+        # Elastic-resharding nudge, same pattern: the actor thread owns
+        # the reshard state — it aborts an in-flight move whose
+        # endpoint died, re-sends a lost Begin, re-broadcasts maps.
+        controller.receive(Message(
+            src=zoo.rank, dst=zoo.rank,
+            msg_type=MsgType.Control_Shard_Tick))

@@ -299,10 +299,21 @@ class ReplicaRouter:
         # the rows' OWNERS — a dead holder must not turn replicated
         # reads into retry loops against a corpse while the owner is
         # alive. A server is re-included when any reply from it lands
-        # (``mark_alive`` via the reply context): after a rejoin its
-        # replica store is empty, so resumed routing just misses and
-        # repairs until the owner's pushes rebuild it — self-healing.
+        # (``mark_alive`` via the reply context), and — the
+        # authoritative path — whenever an epoch-stamped map broadcast
+        # carries the controller's live-server view (``reconcile``):
+        # before that, a rejoined server that got no organic reply
+        # traffic stayed dead-marked indefinitely and its replicas
+        # went unserved. After a rejoin its replica store is empty, so
+        # resumed routing just misses and repairs until the owner's
+        # pushes rebuild it — self-healing.
         self._dead: set = set()
+        #: Resharding supersedes replication for a table: once its
+        #: shard map goes dynamic, ownership moves absorb the skew and
+        #: the static row->owner arithmetic the replica protocol
+        #: assumes is gone (docs/SHARDING.md). A deactivated router
+        #: ignores later promoted-row broadcasts.
+        self._disabled = False
 
     @property
     def active(self) -> bool:
@@ -315,7 +326,7 @@ class ReplicaRouter:
     def apply(self, epoch: int, rows: np.ndarray) -> bool:
         """Adopt a broadcast map; stale epochs (reordered delivery) are
         ignored."""
-        if epoch <= self.epoch:
+        if self._disabled or epoch <= self.epoch:
             return False
         self.epoch = int(epoch)
         rows = np.asarray(rows, dtype=np.int32).reshape(-1)
@@ -335,6 +346,25 @@ class ReplicaRouter:
 
     def mark_alive(self, sid: int) -> None:
         self._dead.discard(int(sid))
+
+    def deactivate(self) -> None:
+        """Permanently retire this router (the table's shard map went
+        dynamic — ownership moves supersede read replicas)."""
+        self._disabled = True
+        self._rows = None
+
+    def reconcile(self, alive_sids) -> None:
+        """Re-validate the dead marks against the controller's
+        authoritative live-server view (carried on every epoch-stamped
+        map broadcast): servers the controller considers alive resume
+        receiving striped reads WITHOUT waiting for organic reply
+        traffic, and servers it declared dead are marked even if no
+        local send ever failed toward them."""
+        alive = {int(s) for s in alive_sids}
+        if not alive:
+            return  # pre-liveness broadcast: keep local knowledge
+        self._dead = {s for s in range(self._num_servers)
+                      if s not in alive}
 
     def route(self, rows: np.ndarray) -> np.ndarray:
         """Holder server id per (replicated) row, or -1 where the
@@ -469,18 +499,34 @@ class ServerReplicaState:
 # [epoch, n_tables, (table_id, n_rows) * n]; blobs 1..n = one int32 row
 # vector per table, in descriptor order.
 
-def pack_replica_map(epoch: int,
-                     promoted: Dict[int, np.ndarray]) -> List[np.ndarray]:
+def pack_replica_map(epoch: int, promoted: Dict[int, np.ndarray],
+                     alive_sids=None) -> List[np.ndarray]:
+    """``alive_sids`` (trailing blob, absent on older payloads) is the
+    controller's authoritative live-server view: routers reconcile
+    their dead marks against it on every broadcast, so a rejoined
+    server resumes serving replicas without waiting for organic
+    traffic (docs/SHARDING.md)."""
     desc = [int(epoch), len(promoted)]
     rows_blobs: List[np.ndarray] = []
     for table_id in sorted(promoted):
         rows = np.asarray(promoted[table_id], dtype=np.int32).reshape(-1)
         desc.extend((int(table_id), int(rows.size)))
         rows_blobs.append(rows)
-    return [np.asarray(desc, dtype=np.int32)] + rows_blobs
+    blobs = [np.asarray(desc, dtype=np.int32)] + rows_blobs
+    if alive_sids is not None:
+        blobs.append(np.asarray(sorted(int(s) for s in alive_sids),
+                                dtype=np.int32))
+    return blobs
 
 
 def unpack_replica_map(blobs) -> Tuple[int, Dict[int, np.ndarray]]:
+    epoch, promoted, _alive = unpack_replica_map_alive(blobs)
+    return epoch, promoted
+
+
+def unpack_replica_map_alive(blobs):
+    """(epoch, promoted, alive_sids-or-None) — the alive vector is the
+    trailing blob when the sender packed one."""
     desc = blobs[0]
     epoch, n_tables = int(desc[0]), int(desc[1])
     promoted: Dict[int, np.ndarray] = {}
@@ -488,7 +534,11 @@ def unpack_replica_map(blobs) -> Tuple[int, Dict[int, np.ndarray]]:
         table_id = int(desc[2 + 2 * i])
         promoted[table_id] = np.asarray(blobs[1 + i],
                                         dtype=np.int32).reshape(-1)
-    return epoch, promoted
+    alive = None
+    if len(blobs) > 1 + n_tables:
+        alive = np.asarray(blobs[1 + n_tables],
+                           dtype=np.int32).reshape(-1)
+    return epoch, promoted, alive
 
 
 class ReplicaCoordinator:

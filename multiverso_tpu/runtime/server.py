@@ -21,8 +21,8 @@ import numpy as np
 
 from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType, mark_error,
-                            mark_replica_reply, stamp_version,
-                            trace_of, unpack_add_batch)
+                            mark_replica_reply, stamp_trace,
+                            stamp_version, trace_of, unpack_add_batch)
 from ..util import log, mt_queue, tracing
 from ..util.configure import define_double, get_flag
 from ..util.dashboard import monitor
@@ -121,6 +121,25 @@ class Server(Actor):
                               self._process_replica_sync)
         self.register_handler(MsgType.Control_Replica_Map,
                               self._process_replica_map)
+        # Live elastic resharding (runtime/shard_map.py,
+        # docs/SHARDING.md): controller-ordered range migration between
+        # live servers + the dual-read/forwarding window.
+        self.register_handler(MsgType.Request_ShardBegin,
+                              self._process_shard_begin)
+        self.register_handler(MsgType.Server_Shard_Pump,
+                              self._process_shard_pump)
+        self.register_handler(MsgType.Request_ShardData,
+                              self._process_shard_data)
+        self.register_handler(MsgType.Request_ShardAck,
+                              self._process_shard_ack)
+        self.register_handler(MsgType.Request_ShardAbort,
+                              self._process_shard_abort)
+        self.register_handler(MsgType.Request_FwdGet,
+                              self._process_fwd_get)
+        self.register_handler(MsgType.Request_FwdAdd,
+                              self._process_fwd_add)
+        self.register_handler(MsgType.Control_Shard_Map,
+                              self._process_shard_map)
         # Fault tolerance: periodic async snapshots + rejoin restore
         # (runtime/snapshot.py), enabled by -snapshot_dir.
         self._snapshots = None
@@ -208,6 +227,7 @@ class Server(Actor):
             # wait() RAISES instead of consuming an empty payload (the
             # actor loop only logs; without this, every server-side CHECK
             # degrades to silent garbage at the caller).
+            forwarded = False
             try:
                 if not msg.data:
                     # Sync-mode clock-tick shard (worker full-coverage
@@ -217,6 +237,16 @@ class Server(Actor):
                     # vector clock).
                     return
                 table = self._table(msg.table_id)
+                # Dual-read window (docs/SHARDING.md): rows this shard
+                # handed off forward to their new owner, which replies
+                # to the requester directly (with OUR still-owned rows
+                # piggybacked) — no reply leaves from here.
+                outs = table.shard_forward_get(msg)
+                if outs is not None:
+                    forwarded = True
+                    for out in outs:
+                        self.send_to(actors.COMMUNICATOR, out)
+                    return
                 with self._lock_for(table), \
                         tracing.span(trace_of(msg), "table_op:get",
                                      self._zoo.rank):
@@ -243,14 +273,19 @@ class Server(Actor):
                 mark_error(reply, exc)
                 raise
             finally:
-                self.send_to(actors.COMMUNICATOR, reply)
+                if not forwarded:
+                    self.send_to(actors.COMMUNICATOR, reply)
             self._replica_flush(table)
 
     def _replica_flush(self, table) -> None:
-        """Send whatever replica traffic the served request made due:
-        write-through refreshes of dirty promoted rows toward the
-        holders, and/or the hot-row report toward the controller."""
+        """Send whatever replica/reshard traffic the served request
+        made due: write-through refreshes of dirty promoted rows
+        toward the holders, the hot-row report toward the controller,
+        and any pending migration re-announcements (a lost
+        Control_Shard_Done resends on traffic)."""
         for out in table.replica_flush_if_due():
+            self.send_to(actors.COMMUNICATOR, out)
+        for out in table.shard_announce():
             self.send_to(actors.COMMUNICATOR, out)
 
     def _process_replica_sync(self, msg: Message) -> None:
@@ -292,6 +327,175 @@ class Server(Actor):
                                                                rows):
                 self.send_to(actors.COMMUNICATOR, out)
 
+    # -- live elastic resharding (runtime/shard_map.py,
+    #    docs/SHARDING.md; all on this actor thread) --
+    def _process_shard_begin(self, msg: Message) -> None:
+        """Controller's move order: the source table starts streaming,
+        driven by local pump messages so serving traffic interleaves
+        between chunks; an unsupported table (sparse bitmap, stateful
+        updater, range not owned) NACKs and the controller rolls the
+        move back."""
+        from .zoo import CONTROLLER_RANK
+        desc = msg.data[0].as_array(np.int64)
+        epoch = int(desc[5])
+        try:
+            table = self._table(msg.table_id)
+            ok = table.shard_begin_out(desc)
+        except Exception:  # noqa: BLE001 - unready table / bad desc
+            ok = False
+        if not ok:
+            log.error("rank %d: refusing shard migration of table %d "
+                      "(epoch %d) — unsupported or not owned",
+                      self._zoo.rank, msg.table_id, epoch)
+            nack = Message(src=self._zoo.rank, dst=CONTROLLER_RANK,
+                           msg_type=MsgType.Control_Shard_Done,
+                           table_id=msg.table_id)
+            nack.push(Blob(np.asarray([epoch, 0, self._zoo.server_id],
+                                      dtype=np.int64)))
+            self.send_to(actors.COMMUNICATOR, nack)
+            return
+        self.receive(Message(src=self._zoo.rank, dst=self._zoo.rank,
+                             msg_type=MsgType.Server_Shard_Pump,
+                             table_id=msg.table_id))
+
+    def _process_shard_pump(self, msg: Message) -> None:
+        try:
+            table = self._table(msg.table_id)
+        except RuntimeError:
+            return
+        outs, more = table.shard_pump()
+        for out in outs:
+            self.send_to(actors.COMMUNICATOR, out)
+        if more:
+            # Re-enqueue so queued serving requests interleave with
+            # the stream — a migration must not starve the shard.
+            self.receive(Message(src=self._zoo.rank, dst=self._zoo.rank,
+                                 msg_type=MsgType.Server_Shard_Pump,
+                                 table_id=msg.table_id))
+
+    def _process_shard_data(self, msg: Message) -> None:
+        try:
+            table = self._table(msg.table_id)
+        except RuntimeError:
+            return  # rejoin gap: the source retransmits on the ack path
+        for out in table.shard_import_chunk(msg):
+            self.send_to(actors.COMMUNICATOR, out)
+
+    def _process_shard_ack(self, msg: Message) -> None:
+        try:
+            table = self._table(msg.table_id)
+        except RuntimeError:
+            return
+        for out in table.shard_ack(msg):
+            self.send_to(actors.COMMUNICATOR, out)
+
+    def _process_shard_abort(self, msg: Message) -> None:
+        try:
+            table = self._table(msg.table_id)
+        except RuntimeError:
+            return
+        for out in table.shard_abort(
+                int(msg.data[0].as_array(np.int64)[0])):
+            self.send_to(actors.COMMUNICATOR, out)
+
+    def _process_shard_map(self, msg: Message) -> None:
+        """Epoch-stamped shard-map broadcast (cloned to this actor by
+        the communicator, like Control_Replica_Map): the named table
+        commits/prunes its migration state."""
+        from . import shard_map as shard_map_mod
+        try:
+            table_id, smap, alive = shard_map_mod.ShardMap.unpack(
+                [b.as_array(np.int64) for b in msg.data])
+        except Exception:  # noqa: BLE001 - malformed broadcast must
+            # not kill the server loop; the next broadcast replaces it.
+            log.error("server: undecodable shard map %r", msg)
+            return
+        if not (0 <= table_id < len(self._store)) \
+                or table_id not in self._ready_ids:
+            return
+        for out in self._store[table_id].apply_shard_map_server(
+                smap.epoch, smap, alive):
+            self.send_to(actors.COMMUNICATOR, out)
+
+    def _process_fwd_get(self, msg: Message) -> None:
+        """A source-forwarded Get (dual-read window): serve the moved
+        rows here, merge the source's piggybacked rows, and reply
+        IMPERSONATING the source rank — the requester's in-flight
+        accounting keys on the shard it actually sent to, and the
+        moved rows ride the reply as a replica group attributed to
+        THIS shard (core/message.py Request_FwdGet)."""
+        with monitor("SERVER_PROCESS_GET"), \
+                tracing.span(trace_of(msg), "server_process_fwd_get",
+                             self._zoo.rank,
+                             args={"table": msg.table_id}):
+            src_rank = int(msg.data[0].as_array(np.int64)[0]) \
+                if msg.data else msg.src
+            reply = Message(src=src_rank, dst=msg.src,
+                            msg_type=MsgType.Reply_Get,
+                            table_id=msg.table_id, msg_id=msg.msg_id)
+            tid = trace_of(msg)
+            if tid:
+                stamp_trace(reply, tid)
+            try:
+                table = self._table(msg.table_id)
+                with self._lock_for(table):
+                    blobs, n_rep, src_rank2, src_version = \
+                        table.process_forward_get(msg.data)
+                    if device_lock.active():
+                        device_lock.settle([b.data for b in blobs
+                                            if b.on_device])
+                reply.src = src_rank2
+                reply.data = blobs
+                stamp_version(reply, src_version)
+                if n_rep:
+                    mark_replica_reply(reply, n_rep)
+            except Exception as exc:  # noqa: BLE001
+                mark_error(reply, exc)
+                raise
+            finally:
+                self.send_to(actors.COMMUNICATOR, reply)
+            # A grow destination may see ONLY forwarded traffic until
+            # the commit lands — the pending-Done re-announce must ride
+            # it (docs/SHARDING.md).
+            self._replica_flush(table)
+
+    def _process_fwd_add(self, msg: Message) -> None:
+        """A source-forwarded Add subset: apply, then ack the
+        requester impersonating the source rank — version-UNSTAMPED
+        (the moved rows' versions now come from THIS shard's counter;
+        stamping it under the source's identity would fire the
+        generation-regression guard spuriously). msg_id < 0 marks a
+        secondary-window forward: applied, never acked."""
+        with monitor("SERVER_PROCESS_ADD"), \
+                tracing.span(trace_of(msg), "server_process_fwd_add",
+                             self._zoo.rank,
+                             args={"table": msg.table_id}):
+            src_rank = int(msg.data[0].as_array(np.int64)[0]) \
+                if msg.data else msg.src
+            reply = None
+            if msg.msg_id >= 0:
+                reply = Message(src=src_rank, dst=msg.src,
+                                msg_type=MsgType.Reply_Add,
+                                table_id=msg.table_id,
+                                msg_id=msg.msg_id)
+                tid = trace_of(msg)
+                if tid:
+                    stamp_trace(reply, tid)
+            try:
+                table = self._table(msg.table_id)
+                with self._lock_for(table):
+                    table.process_add(msg.data[1:])
+                    device_lock.settle(getattr(table, "_data", None))
+                    table.version += 1
+            except Exception as exc:  # noqa: BLE001
+                if reply is not None:
+                    mark_error(reply, exc)
+                raise
+            finally:
+                if reply is not None:
+                    self.send_to(actors.COMMUNICATOR, reply)
+            self._replica_flush(table)
+
     # ref: src/server.cpp:48-58
     def _process_add(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_ADD"), \
@@ -299,12 +503,38 @@ class Server(Actor):
                              self._zoo.rank,
                              args={"table": msg.table_id}):
             reply = msg.create_reply_message()
+            silent = False
             try:
                 if not msg.data:
                     # Clock-tick shard: see _process_get. No version
                     # bump — nothing was applied.
                     return
                 table = self._table(msg.table_id)
+                # Dual-write window (docs/SHARDING.md): moved rows'
+                # deltas forward to the new owner, which acks the
+                # requester; the full add ALSO applies here without an
+                # ack (both-apply — exactly one copy survives the
+                # commit-or-rollback outcome).
+                route = table.shard_forward_add(msg)
+                if route is not None:
+                    silent = True
+                    local_msg, outs = route
+                    for out in outs:
+                        self.send_to(actors.COMMUNICATOR, out)
+                    if local_msg is not None:
+                        with self._lock_for(table):
+                            # Both-apply exemption: this deliberate
+                            # write into the handoff copy must bypass
+                            # the own-window NACK.
+                            table._in_both_apply = True
+                            try:
+                                table.process_add(local_msg.data)
+                            finally:
+                                table._in_both_apply = False
+                            device_lock.settle(
+                                getattr(table, "_data", None))
+                            table.version += 1
+                    return
                 with self._lock_for(table), \
                         tracing.span(trace_of(msg), "table_op:add",
                                      self._zoo.rank):
@@ -326,7 +556,8 @@ class Server(Actor):
                 mark_error(reply, exc)
                 raise
             finally:
-                self.send_to(actors.COMMUNICATOR, reply)
+                if not silent:
+                    self.send_to(actors.COMMUNICATOR, reply)
             self._replica_flush(table)
 
     def _process_batch_add(self, msg: Message) -> None:
@@ -386,6 +617,27 @@ class Server(Actor):
                 for sub in subs:
                     try:
                         table = self._table(sub.table_id)
+                        route = table.shard_forward_add(sub)
+                        if route is not None:
+                            # Dual-write window: the destination acks
+                            # this sub under its own Reply_Add — it
+                            # must NOT appear in this batch ack too.
+                            local_msg, outs = route
+                            for out in outs:
+                                self.send_to(actors.COMMUNICATOR, out)
+                            if local_msg is not None:
+                                with self._lock_for(table):
+                                    table._in_both_apply = True
+                                    try:
+                                        table.process_add(
+                                            local_msg.data)
+                                    finally:
+                                        table._in_both_apply = False
+                                    device_lock.settle(
+                                        getattr(table, "_data", None))
+                                    table.version += 1
+                            touched[sub.table_id] = table
+                            continue
                         with self._lock_for(table):
                             table.process_add(sub.data)
                             device_lock.settle(

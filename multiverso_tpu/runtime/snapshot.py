@@ -179,7 +179,11 @@ class SnapshotManager:
                 f"manifest says {entry['bytes']}) — refusing to "
                 f"restore corrupt parameters")
         with self._table_lock, _state_lock_of(table):
-            table.load(io.BytesIO(data))
+            # The manifest sidecar carries the table's shard-map epoch
+            # and elastic inventory (overlay/forwarding state) so a
+            # rejoin restores into the RIGHT map (docs/SHARDING.md);
+            # sidecar-less entries take the legacy load path.
+            table.load_with_meta(io.BytesIO(data), entry.get("meta"))
             table.version = int(entry["version"])
         self.tables_restored += 1
         log.info("rank %d: restored table %d from %s (version %d)",
@@ -301,12 +305,12 @@ class SnapshotManager:
                 for tid, table in tracked:
                     stack.enter_context(_state_lock_of(table))
                 captures = [(tid, table, table.snapshot_state(),
-                             int(table.version))
+                             int(table.version), table.snapshot_meta())
                             for tid, table in tracked]
         seq = self._seq + 1
         entries: Dict[str, dict] = {}
         with monitor("SNAPSHOT_WRITE"):
-            for tid, table, state, version in captures:
+            for tid, table, state, version, meta in captures:
                 buf = io.BytesIO()
                 table.write_snapshot(state, buf)
                 data = buf.getvalue()
@@ -323,6 +327,11 @@ class SnapshotManager:
                     "table": tid, "shard": self._zoo.server_id,
                     "seq": seq, "version": version, "file": fname,
                     "bytes": len(data), "crc32": zlib.crc32(data)}
+                if meta:
+                    # Elastic sidecar: shard-map epoch + overlay/
+                    # forwarding inventory (tables define it;
+                    # docs/SHARDING.md).
+                    entries[str(tid)]["meta"] = meta
             manifest = {"format": MANIFEST_FORMAT,
                         "rank": self._zoo.rank,
                         "server_id": self._zoo.server_id,

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..core.blob import Blob
 from ..core.message import HEADER_SIZE, Message, trace_of
-from ..util import log, tracing
+from ..util import chaos, log, tracing
 from ..util.configure import (define_double, define_int, define_string,
                               get_flag)
 from ..util.dashboard import monitor
@@ -350,6 +350,20 @@ class TcpNet(NetInterface):
         dst = msg.dst
         if not 0 <= dst < self.size:
             raise ValueError(f"bad dst rank {dst}")
+        # Chaos harness (-chaos_frames, util/chaos.py): direct async
+        # senders (liveness/metrics frames) bypass the communicator's
+        # choke point, so the fault filter hooks here too (one flag
+        # probe when disarmed).
+        faulted = chaos.filter_frames(msg)
+        if faulted is not None:
+            total = 0
+            for m in faulted:
+                total += self._send_async_real(m)
+            return total
+        return self._send_async_real(msg)
+
+    def _send_async_real(self, msg: Message) -> int:
+        dst = msg.dst
         tid = trace_of(msg)
         with monitor("tcp_serialize"), \
                 tracing.span(tid, "tcp_serialize", self._rank):

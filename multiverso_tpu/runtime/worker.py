@@ -99,6 +99,11 @@ class Worker(Actor):
                               self._process_dead_peer)
         self.register_handler(MsgType.Control_Replica_Map,
                               self._process_replica_map)
+        # Elastic resharding (runtime/shard_map.py, docs/SHARDING.md):
+        # the epoch-stamped shard-map broadcast re-routes this worker's
+        # tables on THIS thread (the same thread that partitions).
+        self.register_handler(MsgType.Control_Shard_Map,
+                              self._process_shard_map)
         # Per-destination-server shard counters (bench observability:
         # per-server request counts localize a hot shard). Plain dict,
         # actor-thread only; read via snapshot copy.
@@ -152,7 +157,7 @@ class Worker(Actor):
         router adopts its row set ON THIS THREAD (the same thread that
         partitions), so routing decisions never race the map."""
         try:
-            epoch, promoted = replica_mod.unpack_replica_map(
+            epoch, promoted, alive = replica_mod.unpack_replica_map_alive(
                 [b.as_array(np.int32) for b in msg.data])
         except Exception:  # noqa: BLE001 - a malformed map must not
             # kill the worker loop; the next broadcast replaces it.
@@ -162,6 +167,32 @@ class Worker(Actor):
         for table_id, rows in promoted.items():
             if 0 <= table_id < len(self._cache):
                 self._cache[table_id].apply_replica_map(epoch, rows)
+        if alive is not None:
+            # Reconcile every router's dead marks against the
+            # controller's authoritative live-server view: a rejoined
+            # server resumes serving replicas without waiting for
+            # organic reply traffic (docs/SHARDING.md).
+            for table in self._cache:
+                table.replica_reconcile(alive)
+
+    def _process_shard_map(self, msg: Message) -> None:
+        """Epoch-stamped shard-map broadcast from the controller: the
+        named table adopts the new row->server layout, invalidates
+        client caches for moved ranges (the PR-6 generation-change
+        path) and reconciles its replica router's liveness marks
+        against the controller's authoritative view."""
+        from . import shard_map as shard_map_mod
+        try:
+            table_id, smap, alive = shard_map_mod.ShardMap.unpack(
+                [b.as_array(np.int64) for b in msg.data])
+        except Exception:  # noqa: BLE001 - a malformed broadcast must
+            # not kill the worker loop; the next broadcast replaces it.
+            from ..util import log
+            log.error("worker: undecodable shard map %r", msg)
+            return
+        if 0 <= table_id < len(self._cache):
+            self._cache[table_id].apply_shard_map(smap.epoch, smap,
+                                                  alive)
 
     def _partition_and_send(self, msg: Message, msg_type: MsgType) -> None:
         table = self._cache[msg.table_id]

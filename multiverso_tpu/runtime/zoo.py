@@ -517,6 +517,68 @@ class Zoo:
             # the next barrier() succeed.
             raise PeerLostError(error)
 
+    # -- live elastic resharding (runtime/shard_map.py,
+    #    docs/SHARDING.md) --
+    def reshard_table(self, table, server_ids,
+                      wait_s: float = 60.0) -> None:
+        """Ask the controller to respread ``table`` over exactly
+        ``server_ids`` (grow onto standbys / drain a retiring server)
+        with live row migration — no stop-the-world. Fire-and-forget
+        toward the controller; with ``wait_s`` > 0 this then POLLS the
+        worker table's adopted map until its owner set matches (the
+        commit broadcast is the only completion signal — there is
+        nothing to block on, traffic keeps flowing throughout).
+
+        BSP sync mode refuses (the vector clocks count requests per
+        server); tables whose type cannot migrate (sparse matrix,
+        array) are NACKed by their server and the move rolls back."""
+        if get_flag("sync", False):
+            raise RuntimeError("reshard_table: BSP sync mode pins the "
+                               "frozen shard map")
+        space = table.reshard_space()
+        if space <= 0:
+            raise ValueError(
+                f"table {table.table_id} does not support live "
+                f"resharding (docs/SHARDING.md support matrix)")
+        target = sorted({int(s) for s in server_ids})
+        if not target or target[-1] >= self._num_servers or target[0] < 0:
+            raise ValueError(f"bad server id set {target} "
+                             f"(num_servers={self._num_servers})")
+        msg = Message(src=self.rank, dst=CONTROLLER_RANK,
+                      msg_type=MsgType.Control_Shard_Request,
+                      table_id=table.table_id)
+        msg.push(Blob(np.asarray(
+            [space, int(table.reshard_kind())] + target,
+            dtype=np.int64)))
+        self.send_to(actors.COMMUNICATOR, msg)
+        if wait_s <= 0:
+            return
+        # Poll for the EXACT target layout, not just the owner set —
+        # a multi-move plan passes through intermediate maps whose
+        # owner set already matches (the first grow move creates the
+        # new server's first interval long before the spread evens).
+        from ..tables.matrix_table import row_offsets
+        offsets = row_offsets(space, len(target))
+        expected = (list(offsets),
+                    [target[i] for i in range(len(offsets) - 1)])
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            if self._aborted:
+                raise ClusterAborted(
+                    f"rank {self.rank}: cluster aborted mid-reshard")
+            if table.shard_layout() == expected:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"reshard of table {table.table_id} to servers {target} "
+            f"did not commit within {wait_s}s (layout now: "
+            f"{table.shard_layout()}, wanted {expected})")
+
+    def table_shard_epoch(self, table) -> int:
+        """The shard-map epoch ``table`` has adopted (-1 = frozen
+        creation layout). Bench/test observability."""
+        return table.shard_epoch()
+
     def finish_train(self) -> None:
         """Retire this rank's worker from the BSP clocks on all servers."""
         if self.worker_id < 0:

@@ -39,17 +39,20 @@ import jax
 import numpy as np
 
 from ..core.blob import Blob, is_device_array
-from ..core.message import Message, MsgType
+from ..core.message import (PEER_LOST_MARK, Message, MsgType,
+                            stamp_trace, trace_of)
 from ..runtime import device_lock
 from ..runtime import replica as replica_mod
+from ..runtime import shard_map as shard_map_mod
 from ..runtime.zoo import CONTROLLER_RANK
+from ..util import chaos
 from ..util.dashboard import count as count_event
 from . import client_cache
 from .client_cache import RowCache
 from ..sharding import mesh as meshlib
 from ..updater import AddOption, GetOption, UpdateEngine, create_rule
 from ..updater.engine import bucket_size, pad_ids
-from ..util import wire_codec
+from ..util import log, wire_codec
 from ..util.configure import define_bool, get_flag
 from ..util.log import CHECK
 from ..util.quantization import OneBitFilter
@@ -236,9 +239,20 @@ class MatrixWorker(WorkerTable):
                          and self.dtype == np.float32
                          and bool(get_flag("one_bit_push")))
         self._residual: Optional[np.ndarray] = None
-        self._offsets = row_offsets(self.num_row, self._zoo.num_servers)
+        # Frozen creation-time layout, possibly over only the first
+        # -shard_initial_servers servers (the rest are standbys a
+        # later reshard can grow onto — docs/SHARDING.md).
+        self._init_active = shard_map_mod.initial_active_servers(
+            self._zoo.num_servers)
+        self._offsets = row_offsets(self.num_row, self._init_active)
         self._num_server = len(self._offsets) - 1  # actual servers used
         self._row_length = max(self.num_row // self._num_server, 1)
+        # Live elastic resharding (runtime/shard_map.py): the adopted
+        # epoch-stamped map replaces the frozen division rule; None =
+        # never resharded, byte-identical routing to the reference.
+        # Worker actor thread swaps it; requester threads read it —
+        # one attribute, GIL-atomic.
+        self._shard_map: Optional[shard_map_mod.ShardMap] = None
         # One outstanding Get per table (the reference's shared row_index_
         # registers, ref: matrix_table.cpp:66-76). _dest xor _device_shards
         # names the reply destination.
@@ -258,7 +272,8 @@ class MatrixWorker(WorkerTable):
         if bound > 0 and not self.is_sparse:
             self._row_cache = RowCache(
                 bound, self._server_of_rows,
-                self._num_server, self._version_tracker)
+                max(self._zoo.num_servers, self._num_server),
+                self._version_tracker)
             self._caches.append(self._row_cache)
         # In-flight prefetch registry (+ dedup/join): msg_id -> sorted
         # unique ids being fetched; _pf_by_key dedups identical
@@ -290,18 +305,74 @@ class MatrixWorker(WorkerTable):
                 preferred=local_sid if local_sid >= 0 else None)
 
     def _server_of_rows(self, rows: np.ndarray) -> np.ndarray:
-        """Vectorized row ids -> owning server ids (the row-range
-        sharding rule; shared by the client cache's freshness checks
-        and the serving tier's version attribution)."""
+        """Vectorized row ids -> owning server ids (the one sharding
+        rule; shared by partition routing, the client cache's
+        freshness checks, the replica protocol's owner attribution and
+        the serving tier's version attribution). The frozen division
+        rule until an epoch-stamped shard map is adopted
+        (docs/SHARDING.md elastic resharding)."""
+        smap = self._shard_map
+        if smap is not None:
+            return smap.owner_of(rows)
         return np.minimum(rows // self._row_length, self._num_server - 1)
+
+    # -- elastic resharding: worker side (runtime/shard_map.py) --
+    def apply_shard_map(self, epoch: int, smap, alive_sids) -> None:
+        """Epoch-stamped map broadcast (worker actor thread — the same
+        thread that partitions, so routing never races the swap).
+        Moved intervals invalidate client caches through the PR-6
+        generation-change path BEFORE the swap (``note_shard_moved``,
+        table_interface.py), and the replica router reconciles its
+        dead marks against the broadcast's live-server view — or
+        retires outright once the map is truly dynamic."""
+        old = self._shard_map
+        if old is not None and epoch <= old.epoch:
+            return
+        if old is None:
+            old = shard_map_mod.ShardMap.initial(
+                self.num_row, self._zoo.num_servers,
+                active=self._init_active)
+        moved = old.diff_moved(smap)
+        for old_sid in sorted({m[2] for m in moved}):
+            self.note_shard_moved(old_sid)
+        self._shard_map = smap
+        if self._replica_router is not None:
+            if moved or (old is not None and old.epoch > 0) \
+                    or smap.epoch > 0:
+                self._replica_router.deactivate()
+            else:
+                self._replica_router.reconcile(alive_sids)
+
+    def shard_epoch(self) -> int:
+        return self._shard_map.epoch if self._shard_map is not None \
+            else -1
+
+    def shard_owner_sids(self):
+        return self._shard_map.owner_sids() \
+            if self._shard_map is not None else None
+
+    def shard_layout(self):
+        smap = self._shard_map
+        if smap is None:
+            return None
+        return (smap.bounds.tolist(), smap.owners.tolist())
+
+    def reshard_space(self) -> int:
+        """Dense host-path matrix tables reshard at row granularity;
+        sparse tables do not (their per-consumer dirty bitmaps are
+        keyed to the frozen layout — the server NACKs a Begin and the
+        controller rolls the move back)."""
+        return 0 if self.is_sparse else self.num_row
 
     def observed_versions(self) -> Dict[int, int]:
         """Latest shard version this worker has OBSERVED, per server id
         (-1 before any reply). Serving-tier metadata (docs/SERVING.md):
         staleness is measured against these, exactly as the client
         cache measures it."""
-        return {s: self._version_tracker.latest(s)
-                for s in range(self._num_server)}
+        sids = range(self._num_server) if self._shard_map is None \
+            else self._shard_map.owner_sids()
+        return {int(s): self._version_tracker.latest(int(s))
+                for s in sids}
 
     def _check_row_ids(self, row_ids: np.ndarray) -> None:
         """Fail fast in the CALLER on out-of-range ids. partition() runs
@@ -313,6 +384,15 @@ class MatrixWorker(WorkerTable):
             lo, hi = int(row_ids.min()), int(row_ids.max())
             CHECK(lo >= 0 and hi < self.num_row,
                   "row ids out of range [0, num_row)")
+
+    def _check_frozen_layout(self, what: str) -> None:
+        """Device-resident fast paths bake the frozen per-server
+        layout into shapes and program caches (per-server segments,
+        broadcast masks, fused jits) — they cannot follow a live map.
+        Elastic clusters use the host row path; fail in the CALLER."""
+        CHECK(self._shard_map is None,
+              f"{what} needs the frozen shard layout — this table "
+              f"adopted a dynamic shard map (docs/SHARDING.md)")
 
     # -- Get API (ref: matrix_table.cpp:58-105) --
     def get(self, out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -328,6 +408,15 @@ class MatrixWorker(WorkerTable):
             alloc = np.zeros if self.is_sparse else np.empty
             out = alloc((self.num_row, self.num_col), self.dtype)
         CHECK(out.shape == (self.num_row, self.num_col), "bad output shape")
+        if self._shard_map is not None and not self.is_sparse:
+            # Dynamic map: the whole-table sentinel's reply placement
+            # assumes the frozen per-server offsets — route as an
+            # all-rows row Get instead (replies carry keys, placement
+            # is layout-free). Costs the id vector on the wire; full-
+            # table pulls on an elastically resharded table are not a
+            # hot path (docs/SHARDING.md).
+            return self.get_rows_async(
+                np.arange(self.num_row, dtype=np.int32), out)
         self._dest, self._dest_rows, self._device_shards = out, None, None
         return self._request_get(Blob(_ALL_KEY.view(np.uint8)))
 
@@ -552,6 +641,7 @@ class MatrixWorker(WorkerTable):
         every row is owned by exactly one server, so the sum
         reassembles the exact gather. Costs one extra [k, C] pass per
         additional server, all in HBM."""
+        self._check_frozen_layout("device row gets")
         if is_device_array(row_ids):
             CHECK(self._zoo.servers_in_process,
                   "device-key row gets need the servers in this "
@@ -609,6 +699,7 @@ class MatrixWorker(WorkerTable):
         protocol: per-server gather cost follows the SEGMENT size, not
         the full id count (ref per-server bucketing contract:
         matrix_table.cpp:234-315)."""
+        self._check_frozen_layout("segmented device gets")
         CHECK(self._zoo.servers_in_process,
               "segmented device gets need the servers in this process")
         CHECK(len(segments) == self._num_server,
@@ -635,6 +726,7 @@ class MatrixWorker(WorkerTable):
         each server scatter-adds only its segment (foreign/padding rows
         mask out-of-range and drop). Same stateless-updater contract as
         ``add_rows_async`` device keys."""
+        self._check_frozen_layout("segmented device adds")
         CHECK(self._zoo.servers_in_process,
               "segmented device adds need the servers in this process")
         CHECK(len(segments) == self._num_server
@@ -697,6 +789,18 @@ class MatrixWorker(WorkerTable):
             delta = np.ascontiguousarray(delta, self.dtype).reshape(-1)
         CHECK(int(np.prod(delta.shape)) == self.num_row * self.num_col,
               "bad delta size")
+        if self._shard_map is not None and not self.is_sparse \
+                and not is_device_array(delta):
+            # Dynamic map: the sentinel add slices per the frozen
+            # offsets — route as an all-rows row Add instead (keys
+            # travel, the partition buckets by the live map).
+            return self.add_rows_async(
+                np.arange(self.num_row, dtype=np.int32),
+                delta.reshape(self.num_row, self.num_col), option)
+        CHECK(self._shard_map is None or self.is_sparse
+              or not is_device_array(delta),
+              "whole-table device adds need the frozen shard layout "
+              "(live resharding serves the host row path)")
         tok = self._cache_begin_add(None)
         mid = self.add_async_raw(Blob(_ALL_KEY.view(np.uint8)),
                                  Blob(delta),
@@ -737,6 +841,7 @@ class MatrixWorker(WorkerTable):
             # each scatter-adds only its own rows (foreign rows masked
             # out-of-range and dropped), so the union applies the full
             # delta exactly once.
+            self._check_frozen_layout("device-key row adds")
             CHECK(self._zoo.servers_in_process,
                   "device-key row adds need the servers in this "
                   "process")
@@ -907,7 +1012,7 @@ class MatrixWorker(WorkerTable):
                                  and int(keys.max()) < self.num_row),
               "row ids out of range [0, num_row)")
         is_add = msg_type == MsgType.Request_Add
-        dest = np.minimum(keys // self._row_length, self._num_server - 1)
+        dest = self._server_of_rows(keys)
         if (not is_add and self._replica_router is not None
                 and self._replica_router.active):
             # Replicated (hot) rows re-route to holder servers — the
@@ -1092,6 +1197,7 @@ class MatrixWorker(WorkerTable):
 
     # -- device-resident whole-table Get (shards stay in HBM) --
     def get_device(self):
+        self._check_frozen_layout("device whole-table gets")
         CHECK(not self.is_sparse,
               "device get is for dense tables (sparse replies are ragged)")
         self._dest, self._dest_rows, self._device_shards = None, None, {}
@@ -1240,6 +1346,10 @@ class MatrixWorker(WorkerTable):
         if self._replica_router is not None and server_id >= 0:
             self._replica_router.mark_alive(server_id)
 
+    def replica_reconcile(self, alive_sids) -> None:
+        if self._replica_router is not None:
+            self._replica_router.reconcile(alive_sids)
+
     def _note_replica_routed(self, keys: np.ndarray, dest: np.ndarray,
                              rep_mask: np.ndarray) -> None:
         """Record which FOREIGN rows (owner != holder) the current
@@ -1251,8 +1361,7 @@ class MatrixWorker(WorkerTable):
         size cap."""
         if self._partition_msg_id < 0:
             return
-        owners = np.minimum(keys // self._row_length,
-                            self._num_server - 1)
+        owners = self._server_of_rows(keys)
         foreign = rep_mask & (dest != owners)
         if not bool(foreign.any()):
             return
@@ -1335,14 +1444,13 @@ class MatrixWorker(WorkerTable):
         if not repair:
             return
         rows = np.unique(np.concatenate(repair)).astype(np.int32)
-        owners = np.minimum(rows // self._row_length,
-                            self._num_server - 1)
+        owners = self._server_of_rows(rows)
         for sid in np.unique(owners):
             chunk = np.ascontiguousarray(rows[owners == sid])
             self._stage_repair(int(sid), [Blob(chunk.view(np.uint8))])
 
 
-class MatrixServer(ServerTable):
+class MatrixServer(shard_map_mod.ElasticServerMixin, ServerTable):
     def __init__(self, num_row: int, num_col: int, dtype=np.float32,
                  is_sparse: bool = False, is_pipeline: bool = False,
                  zoo=None, updater_type: Optional[str] = None,
@@ -1357,7 +1465,10 @@ class MatrixServer(ServerTable):
         self._one_bit = (not self.is_sparse
                          and np.dtype(dtype) == np.float32
                          and bool(get_flag("one_bit_push")))
-        offsets = row_offsets(int(num_row), self._zoo.num_servers)
+        self.num_row = int(num_row)
+        offsets = row_offsets(
+            int(num_row),
+            shard_map_mod.initial_active_servers(self._zoo.num_servers))
         sid = self._zoo.server_id
         self.server_id = sid
         if sid >= len(offsets) - 1:
@@ -1418,6 +1529,51 @@ class MatrixServer(ServerTable):
                 and replica_mod.replication_enabled()):
             self._replica = replica_mod.ServerReplicaState(
                 self.row_offset, self.my_rows)
+        # -- live elastic resharding state (runtime/shard_map.py,
+        #    docs/SHARDING.md; server actor thread only) --
+        #: adopted epoch-stamped map (None = frozen creation layout)
+        self._smap: Optional[shard_map_mod.ShardMap] = None
+        #: migrated-IN rows: global row id -> host value row. The
+        #: destination side of a move keeps acquired rows host-side
+        #: (a numpy gather serves them, like the replica store) — the
+        #: device base array keeps its creation-time shape.
+        self._overlay: Dict[int, np.ndarray] = {}
+        #: forwarded adds for rows whose base chunk is still in flight
+        #: (retransmit window only): row -> accumulated signed delta,
+        #: merged when the chunk lands.
+        self._pending_delta: Dict[int, np.ndarray] = {}
+        #: dual-read/forwarding windows this shard is the OLD owner
+        #: of: (lo, hi, dst_sid, dst_rank). Kept indefinitely — a
+        #: stale router may send moved rows here long after commit.
+        self._fwd: List[tuple] = []
+        self._mig_out: Optional[shard_map_mod.MigrationOut] = None
+        self._mig_in: Dict[int, shard_map_mod.MigrationIn] = {}
+        #: requests forwarded into a dual-read/write window since the
+        #: last map apply: (requester rank, msg_id, is_get). The
+        #: requester tracks them against THIS rank, so if the window's
+        #: DESTINATION dies, only this shard can fail their waiters —
+        #: shard_abort drains the list into retryable error replies.
+        #: Bounded; error replies for long-completed ids are no-ops.
+        self._fwd_inflight: List[tuple] = []
+        #: True while the server applies the BOTH-APPLY half of a
+        #: forwarded add to this (source) shard's handoff copy —
+        #: exempts the own-forwarding-window NACK (server actor
+        #: thread only).
+        self._in_both_apply = False
+        #: host twin of the (stateless) update rule for overlay rows:
+        #: default adds, sgd subtracts. Stateful rules refuse to
+        #: migrate (shard_begin_out).
+        self._updater_sign = -1.0 if updater_type == "sgd" else 1.0
+        self._updater_stateless = create_rule(updater_type,
+                                              dtype).stateless
+        #: -reshard_auto load tracking without replication: the same
+        #: HotTracker windows feed the controller's skew-split planner
+        #: (runtime/shard_map.py ReshardManager.note_report).
+        self._hot: Optional[replica_mod.HotTracker] = None
+        if (not self.is_sparse and self._zoo.num_servers > 1
+                and self._replica is None
+                and bool(get_flag("reshard_auto"))):
+            self._hot = replica_mod.HotTracker()
 
     # -- Add (ref: matrix_table.cpp:386-418, sparse_matrix_table.cpp:200-223)
     def process_add(self, blobs: List[Blob]) -> None:
@@ -1440,6 +1596,12 @@ class MatrixServer(ServerTable):
                 # host sync: conservatively dirty every own promoted
                 # row for the next write-through flush.
                 self._replica.note_add_all()
+            if self._mig_out is not None and self._mig_out.streaming:
+                # Unenumerable device ids: conservatively re-stream
+                # every already-sent row of the moving range.
+                self._mig_out.note_add(np.arange(
+                    self._mig_out.lo, self._mig_out.sent_hi,
+                    dtype=np.int64))
             return
         keys = blobs[0].as_array(np.int32)
         if self._compress and len(blobs) in (2, 3) \
@@ -1476,16 +1638,26 @@ class MatrixServer(ServerTable):
                 self._mark_dirty(slice(None), option)
             if self._replica is not None:
                 self._replica.note_add_all()
+            if self._mig_out is not None and self._mig_out.streaming:
+                # Whole-shard add while a range streams out: every
+                # already-sent row goes dirty (re-streams in the final
+                # chunk).
+                self._mig_out.note_add(np.arange(
+                    self._mig_out.lo, self._mig_out.sent_hi,
+                    dtype=np.int64))
             return
-        local_rows = keys - self.row_offset
         if is_device_array(delta):
             delta = _shaped_rows(delta, keys.size, self.num_col)
         else:
             delta = np.asarray(delta).reshape(keys.size, self.num_col)
-        self._data = self._engine.apply_rows(self._data, local_rows, delta,
-                                             option)
-        if self._up_to_date is not None:
-            self._mark_dirty(local_rows, option)
+        if self._elastic_active():
+            self._elastic_row_add(keys, delta, option)
+        else:
+            local_rows = keys - self.row_offset
+            self._data = self._engine.apply_rows(self._data, local_rows,
+                                                 delta, option)
+            if self._up_to_date is not None:
+                self._mark_dirty(local_rows, option)
         if self._replica is not None:
             # Write-through: promoted rows this Add touched refresh to
             # the holders on the next flush cadence.
@@ -1537,6 +1709,15 @@ class MatrixServer(ServerTable):
                 return self._sparse_get_all(GetOption.from_blob(blobs[1]))
             return [blobs[0], Blob(self._values()),
                     Blob(np.array([self.server_id], dtype=np.int32))]
+        if self._hot is not None:
+            self._hot.note(keys)
+        if self._elastic_active():
+            # Dynamic ownership: rows serve from the device base range
+            # or the migrated-in overlay; a row that is neither NACKs
+            # retryably (the requester's map is in motion).
+            return [blobs[0],
+                    Blob(self._gather_rows_elastic(
+                        keys.astype(np.int64)))]
         if self._replica is not None:
             # Hot tracking counts every row REQUESTED here — owned or
             # replica-routed; each row request lands on exactly one
@@ -1628,6 +1809,21 @@ class MatrixServer(ServerTable):
 
     def replica_flush_if_due(self) -> List[Message]:
         if self._replica is None:
+            if self._hot is not None and self._hot.due:
+                # -reshard_auto without replication: ship the load
+                # window so the controller's skew planner sees it
+                # (runtime/shard_map.py ReshardManager.note_report).
+                rows, counts = self._hot.take_report(top_k=16)
+                if rows.size == 0:
+                    return []
+                msg = Message(src=self._zoo.rank, dst=CONTROLLER_RANK,
+                              msg_type=MsgType.Control_Replica_Report,
+                              table_id=self.table_id)
+                msg.push(Blob(rows))
+                msg.push(Blob(counts))
+                msg.push(Blob(np.asarray(
+                    [self.num_row, self.server_id], dtype=np.int64)))
+                return [msg]
             return []
         out: List[Message] = []
         dirty = self._replica.take_due_sync()
@@ -1697,6 +1893,461 @@ class MatrixServer(ServerTable):
                 msg.push(Blob(meta))
                 out.append(msg)
         return out
+
+    # -- live elastic resharding: server side (runtime/shard_map.py,
+    #    docs/SHARDING.md; everything on the server actor thread) --
+    def _elastic_active(self) -> bool:
+        """Any dynamic-ownership state at all: the static fast paths
+        stay byte-identical until the first migration touches this
+        shard."""
+        return bool(self._overlay or self._pending_delta or self._fwd
+                    or self._mig_in or self._mig_out is not None
+                    or self._smap is not None)
+
+    def _gather_rows_elastic(self, keys: np.ndarray) -> np.ndarray:
+        """Serve rows from the migrated-in overlay (host gather, like
+        the replica store) or the device base range; a row that is
+        neither — routed here by a map the cluster moved past, or its
+        base chunk still in retransmit — NACKs retryably so the
+        requester re-issues instead of consuming garbage."""
+        keys = np.asarray(keys, dtype=np.int64)
+        values = np.empty((keys.size, self.num_col), self.dtype)
+        ov = self._overlay
+        in_base = (keys >= self.row_offset) \
+            & (keys < self.row_offset + self.my_rows)
+        # Rows of an INCOMPLETE inbound migration must not fall through
+        # to the base range: a range that left this shard and is coming
+        # BACK still has its pre-first-move values in the device base —
+        # serving them mid-retransmit would be silently stale. The same
+        # goes for rows inside one of THIS shard's own forwarding
+        # windows (a chained move A->B->C can land a stale-routed
+        # request at the dead middle hop; its base copy must NACK, not
+        # serve).
+        in_mig = np.zeros(keys.size, dtype=bool)
+        for mig in self._mig_in.values():
+            if not mig.complete:
+                in_mig |= (keys >= mig.lo) & (keys < mig.hi)
+        fwd_mask, _, _ = self._fwd_route(keys)
+        in_mig |= fwd_mask
+        base_pos: List[int] = []
+        for i, k in enumerate(keys.tolist()):
+            row = ov.get(k)
+            if row is not None:
+                values[i] = row
+            elif in_base[i] and not in_mig[i]:
+                base_pos.append(i)
+            else:
+                raise RuntimeError(
+                    f"{PEER_LOST_MARK} rank {self._zoo.rank}: row {k} "
+                    f"not serveable on server {self.server_id} (shard "
+                    f"map in motion) — re-issue")
+        if base_pos:
+            pos = np.asarray(base_pos, dtype=np.int64)
+            local = (keys[pos] - self.row_offset).astype(np.int32)
+            padded = pad_ids(local, self._data.shape[0])
+            with device_lock.guard():
+                gathered = device_lock.settle(
+                    self._gather(self._data, padded))
+            values[pos] = np.asarray(_trim_rows(gathered, local.size))
+        return values
+
+    def _elastic_row_add(self, keys: np.ndarray, delta,
+                         option: Optional[AddOption]) -> None:
+        """Row add under dynamic ownership: base rows batch through
+        the jitted engine, overlay rows apply host-side via the
+        stateless rule twin (+/- delta), rows whose base chunk is
+        still in flight accumulate in the pending-delta ledger (merged
+        when the retransmitted chunk lands). Rows a range move is
+        streaming out re-dirty for the final chunk."""
+        if self._mig_out is not None and self._mig_out.streaming:
+            self._mig_out.note_add(keys.astype(np.int64))
+        delta = np.asarray(delta, dtype=self.dtype).reshape(
+            keys.size, self.num_col)
+        ov, pend = self._overlay, self._pending_delta
+        sign = self.dtype.type(self._updater_sign)
+        in_base = (keys >= self.row_offset) \
+            & (keys < self.row_offset + self.my_rows)
+        in_mig = np.zeros(keys.size, dtype=bool)
+        for mig in self._mig_in.values():
+            if not mig.complete:
+                in_mig |= (keys >= mig.lo) & (keys < mig.hi)
+        # Rows in this shard's OWN forwarding windows are not appliable
+        # here — EXCEPT on the both-apply path, where the server
+        # deliberately applies the full add to the handoff copy so a
+        # rollback keeps it (Server._process_add route branch).
+        if not self._in_both_apply:
+            fwd_mask, _, _ = self._fwd_route(keys)
+        else:
+            fwd_mask = np.zeros(keys.size, dtype=bool)
+        # VALIDATE everything before mutating anything: a partial
+        # apply followed by the retryable error would double-apply the
+        # applied prefix when the caller re-issues (at-least-once).
+        for i, k in enumerate(keys.tolist()):
+            if k in ov:
+                continue
+            if fwd_mask[i] or not (in_base[i] or in_mig[i]):
+                raise RuntimeError(
+                    f"{PEER_LOST_MARK} rank {self._zoo.rank}: add to "
+                    f"row {k} not owned by server {self.server_id} "
+                    f"(shard map in motion) — re-issue")
+        base_pos: List[int] = []
+        for i, k in enumerate(keys.tolist()):
+            row = ov.get(k)
+            if row is not None:
+                ov[k] = row + sign * delta[i]
+            elif in_base[i] and not in_mig[i]:
+                base_pos.append(i)
+            else:
+                prev = pend.get(k)
+                pend[k] = sign * delta[i].copy() if prev is None \
+                    else prev + sign * delta[i]
+        if base_pos:
+            pos = np.asarray(base_pos, dtype=np.int64)
+            local = (keys[pos] - self.row_offset).astype(np.int32)
+            self._data = self._engine.apply_rows(
+                self._data, local, np.ascontiguousarray(delta[pos]),
+                option)
+
+    def shard_begin_out(self, desc) -> bool:
+        lo, hi, src_sid, dst_sid, dst_rank, epoch = (
+            int(v) for v in np.asarray(desc)[:6])
+        if self.is_sparse or not self._updater_stateless:
+            return False  # dirty bitmaps / stateful optimizer rows
+            # cannot migrate live — the controller rolls the move back
+        if self._mig_out is not None:
+            if self._mig_out.epoch == epoch:
+                # Duplicate Begin (the controller re-sent it): if the
+                # handoff already happened, the controller's view is
+                # STALLED — a lost Done with no destination traffic to
+                # ride the re-announce on. Re-send the final chunk
+                # (the destination dedups the seq and re-announces).
+                self._mig_out.resend_final = self._mig_out.final_sent
+                return True
+            if self._mig_out.final_sent and epoch > self._mig_out.epoch:
+                # The controller serializes moves, so a Begin for a
+                # NEWER epoch proves the previous move committed — its
+                # broadcast merely lost a race with this Begin (the
+                # Begin rides the per-destination dispatch queue, the
+                # broadcast the communicator actor thread). Retire it;
+                # the forwarding window installed at its handoff stays.
+                self._mig_out = None
+            else:
+                return False
+        if src_sid != self.server_id:
+            return False
+        rows = np.arange(lo, hi, dtype=np.int64)
+        mask, _, _ = self._fwd_route(rows)
+        if bool(mask.any()):
+            return False  # part of the range already moved away
+        in_base = (rows >= self.row_offset) \
+            & (rows < self.row_offset + self.my_rows)
+        if any(not b and r not in self._overlay
+               for r, b in zip(rows.tolist(), in_base.tolist())):
+            return False  # not (fully) owned here
+        self._mig_out = shard_map_mod.MigrationOut(
+            self.table_id, lo, hi, src_sid, dst_sid, dst_rank, epoch)
+        chaos.kill_point("shard_begin_accepted")
+        return True
+
+    def _shard_data_message(self, mig, seq: int, rows: np.ndarray,
+                            is_final: bool) -> Message:
+        if mig.frozen is not None:
+            # Post-handoff retransmit: values come from the handoff
+            # snapshot, never the live copy (forwarded Adds keep
+            # both-applying there — see ElasticServerMixin.shard_ack).
+            values = mig.frozen[rows - mig.lo] if rows.size else \
+                np.empty((0, self.num_col), self.dtype)
+        else:
+            values = self._gather_rows_elastic(rows) if rows.size else \
+                np.empty((0, self.num_col), self.dtype)
+        desc = np.asarray(
+            [mig.epoch, mig.src_sid, mig.dst_sid, self._zoo.rank,
+             mig.lo, mig.hi, seq, 1 if is_final else 0,
+             self.version + 1, len(mig.chunks)], dtype=np.int64)
+        msg = Message(src=self._zoo.rank, dst=mig.dst_rank,
+                      msg_type=MsgType.Request_ShardData,
+                      table_id=self.table_id)
+        msg.push(Blob(desc))
+        msg.push(Blob(rows.astype(np.int64)))
+        msg.push(Blob(values))
+        count_event("SHARD_MIGRATE_ROWS", int(rows.size))
+        return msg
+
+    def _freeze_range(self, mig):
+        whole = np.arange(mig.lo, mig.hi, dtype=np.int64)
+        return self._gather_rows_elastic(whole) if whole.size \
+            else np.empty((0, self.num_col), self.dtype)
+
+    def shard_import_chunk(self, msg: Message):
+        desc = msg.data[0].as_array(np.int64)
+        (epoch, src_sid, dst_sid, src_rank, lo, hi, seq, is_final,
+         wire_version, _n_chunks) = (int(v) for v in desc[:10])
+        if dst_sid != self.server_id:
+            return []
+        mig = self._mig_in.get(epoch)
+        if mig is None:
+            mig = self._mig_in[epoch] = shard_map_mod.MigrationIn(
+                epoch, src_sid, src_rank, lo, hi)
+        if not mig.complete and mig.note_applied(seq):
+            rows = msg.data[1].as_array(np.int64)
+            values = msg.data[2].as_array(self.dtype).reshape(
+                rows.size, self.num_col)
+            if is_final:
+                mig.final_items = set(int(r) for r in rows.tolist())
+            pend = self._pending_delta
+            for i, r in enumerate(rows.tolist()):
+                if not is_final and mig.final_items is not None \
+                        and r in mig.final_items:
+                    # A reorder-delayed base chunk landing AFTER the
+                    # final: the final's copy of this dirty row is
+                    # newer — never overwrite it.
+                    continue
+                v = np.array(values[i], copy=True)
+                extra = pend.pop(r, None)
+                if extra is not None:
+                    # Forwarded Adds that beat this (retransmitted)
+                    # chunk merged into the ledger — fold them in.
+                    v = v + extra
+                self._overlay[r] = v
+        if is_final and not mig.complete:
+            mig.n_chunks = seq
+            mig.src_version = wire_version - 1
+            chaos.kill_point("shard_dest_final")
+        if mig.n_chunks is None:
+            return []
+        if mig.check_complete():
+            chaos.kill_point("shard_dest_complete")
+            return self._announce_done(mig)
+        if is_final:
+            return self._retransmit_request(mig)
+        return []
+
+    def shard_abort(self, epoch: int):
+        epoch = int(epoch)
+        out: List[Message] = []
+        mig = self._mig_out
+        if mig is not None and mig.epoch == epoch:
+            if mig.final_sent:
+                # Post-handoff rollback: drop the forwarding window
+                # and resume serving from the (still present) base
+                # copy — Adds forwarded since the handoff are the
+                # documented at-least-once loss of a dead destination.
+                self._fwd = [f for f in self._fwd
+                             if not (f[0] == mig.lo and f[1] == mig.hi
+                                     and f[2] == mig.dst_sid)]
+                log.error("rank %d: migration [%d,%d) -> server %d "
+                          "rolled back — resuming ownership from the "
+                          "handoff copy", self._zoo.rank, mig.lo,
+                          mig.hi, mig.dst_sid)
+                out.extend(self._drain_fwd_inflight())
+            self._mig_out = None
+        mig_in = self._mig_in.pop(epoch, None)
+        if mig_in is not None:
+            for r in [r for r in self._overlay
+                      if mig_in.lo <= r < mig_in.hi]:
+                del self._overlay[r]
+            for r in [r for r in self._pending_delta
+                      if mig_in.lo <= r < mig_in.hi]:
+                del self._pending_delta[r]
+            log.error("rank %d: inbound migration epoch %d aborted — "
+                      "partial [%d,%d) state dropped", self._zoo.rank,
+                      epoch, mig_in.lo, mig_in.hi)
+        return out
+
+    def apply_shard_map_server(self, epoch: int, smap, alive_sids):
+        if self.is_sparse:
+            return []
+        if self._smap is not None and epoch <= self._smap.epoch:
+            return []
+        old = self._smap if self._smap is not None else \
+            shard_map_mod.ShardMap.initial(
+                self.num_row, self._zoo.num_servers,
+                active=shard_map_mod.initial_active_servers(
+                    self._zoo.num_servers))
+        moved = old.diff_moved(smap)
+        for lo, hi, old_sid, new_sid in moved:
+            if old_sid == self.server_id:
+                # Committed away: prune overlay copies; (re)install the
+                # forwarding window for routers still behind this epoch.
+                for r in [r for r in self._overlay if lo <= r < hi]:
+                    del self._overlay[r]
+                if not any(f[0] <= lo and hi <= f[1] and f[2] == new_sid
+                           for f in self._fwd):
+                    self._fwd.append(
+                        (lo, hi, new_sid,
+                         self._zoo.server_rank(new_sid)))
+            if new_sid == self.server_id:
+                # Committed to me: stale windows pointing away clear
+                # (a range that came back must serve here again).
+                self._prune_fwd_windows(lo, hi)
+        if self._mig_out is not None \
+                and self._mig_out.epoch <= epoch \
+                and int(smap.owner_of(np.asarray(
+                    [self._mig_out.lo]))[0]) == self._mig_out.dst_sid:
+            self._mig_out = None  # committed
+        for e in [e for e, m in self._mig_in.items()
+                  if m.complete and e <= epoch]:
+            self._mig_in.pop(e)
+        if moved and self._replica is not None:
+            log.info("rank %d: table %d shard map went dynamic — "
+                     "retiring hot-row replication for it (ownership "
+                     "moves supersede read replicas)", self._zoo.rank,
+                     self.table_id)
+            self._replica = None
+        # A commit broadcast proves the forwarded requests' window
+        # destination is alive and serving: the rollback ledger resets.
+        self._fwd_inflight = []
+        self._smap = smap
+        return []
+
+    def shard_forward_get(self, msg: Message):
+        if not self._fwd or not msg.data:
+            return None
+        blob0 = msg.data[0]
+        if blob0.on_device:
+            return None
+        keys = blob0.as_array(np.int32)
+        if keys.size == 0 or (keys.size == 1 and keys[0] < 0):
+            # Sentinel ops from routers still on the frozen layout keep
+            # the frozen path (they see the handoff-time snapshot of
+            # moved rows until their map catches up — bounded by the
+            # broadcast cadence; docs/SHARDING.md).
+            return None
+        k64 = keys.astype(np.int64)
+        mask, dst_sid, dst_rank = self._fwd_route(k64)
+        if not bool(mask.any()):
+            return None
+        count_event("SHARD_FWD")
+        dsts = sorted({int(d) for d in dst_sid[mask]})
+        if len(dsts) > 1:
+            raise RuntimeError(
+                f"{PEER_LOST_MARK} rows span {len(dsts)} forwarding "
+                f"windows (router several epochs behind) — re-issue "
+                f"after the next shard-map broadcast")
+        if self._hot is not None:
+            self._hot.note(keys[~mask])
+        overflow = self._note_fwd_inflight(msg.src, msg.msg_id, True)
+        pig_keys = np.ascontiguousarray(keys[~mask])
+        pig_vals = self._gather_rows_elastic(pig_keys) if pig_keys.size \
+            else np.empty((0, self.num_col), self.dtype)
+        meta = np.asarray([self._zoo.rank, self.version + 1],
+                          dtype=np.int64)
+        fwd = Message(src=msg.src, dst=int(dst_rank[mask][0]),
+                      msg_type=MsgType.Request_FwdGet,
+                      table_id=self.table_id, msg_id=msg.msg_id)
+        tid = trace_of(msg)
+        if tid:
+            stamp_trace(fwd, tid)
+        fwd.push(Blob(meta))
+        fwd.push(Blob(np.ascontiguousarray(keys[mask]).view(np.uint8)))
+        fwd.push(Blob(pig_keys.view(np.uint8)))
+        fwd.push(Blob(pig_vals))
+        return [fwd] + overflow
+
+    def process_forward_get(self, blobs: List[Blob]):
+        meta = blobs[0].as_array(np.int64)
+        src_rank, src_version = int(meta[0]), int(meta[1]) - 1
+        fwd_keys = blobs[1].as_array(np.int32)
+        pig_keys = blobs[2].as_array(np.int32)
+        pig_vals = blobs[3].as_array(self.dtype).reshape(
+            pig_keys.size, self.num_col)
+        if self._hot is not None:
+            self._hot.note(fwd_keys)
+        vals = self._gather_rows_elastic(fwd_keys.astype(np.int64))
+        keys_out = np.ascontiguousarray(
+            np.concatenate([pig_keys, fwd_keys]).astype(np.int32))
+        vals_out = np.concatenate([pig_vals, vals]) if pig_keys.size \
+            else vals
+        # The source's piggybacked rows are the reply's MAIN body (the
+        # reply impersonates the source rank, version-stamped with the
+        # source's shard version); this shard's rows ride as one
+        # replica group at OUR version floor — the PR-7 reply contract
+        # reused verbatim, so the requester's attribution, RYW floors
+        # and repair machinery apply unchanged.
+        desc = np.asarray([1, self.server_id, self.version + 1,
+                           int(fwd_keys.size)], dtype=np.int32)
+        return ([Blob(keys_out.view(np.uint8)), Blob(vals_out),
+                 Blob(desc)], int(fwd_keys.size), src_rank, src_version)
+
+    def _decode_add_values(self, blobs: List[Blob],
+                           n: int) -> Optional[np.ndarray]:
+        """Host decode of a row add's delta for window splitting; None
+        when the layout cannot be split (unknown framing)."""
+        if len(blobs) >= 2 and blobs[1].on_device:
+            return np.asarray(blobs[1].typed(self.dtype)).reshape(
+                n, self.num_col)
+        if self._one_bit and len(blobs) == 4:
+            return _onebit_decode(blobs[1], blobs[2]).reshape(
+                n, self.num_col)
+        if len(blobs) in (2, 3):
+            if self._compress and _is_codec_blob(blobs[1]):
+                return _decompress_values(blobs[1], self.dtype).reshape(
+                    n, self.num_col)
+            return blobs[1].as_array(self.dtype).reshape(
+                n, self.num_col)
+        return None
+
+    def shard_forward_add(self, msg: Message):
+        if not self._fwd or not msg.data:
+            return None
+        blobs = msg.data
+        if blobs[0].on_device:
+            return None  # device-key adds are frozen-layout only
+        keys = blobs[0].as_array(np.int32)
+        if keys.size == 0:
+            return None
+        if keys.size == 1 and keys[0] < 0:
+            if int(keys[0]) != -1:
+                return None
+            keys_eff = np.arange(self.row_offset,
+                                 self.row_offset + self.my_rows,
+                                 dtype=np.int64)
+        else:
+            keys_eff = keys.astype(np.int64)
+        mask, dst_sid, dst_rank = self._fwd_route(keys_eff)
+        if not bool(mask.any()):
+            return None
+        delta = self._decode_add_values(blobs, keys_eff.size)
+        if delta is None:
+            raise RuntimeError(
+                f"{PEER_LOST_MARK} cannot split this add layout "
+                f"across a forwarding window — re-issue")
+        option_blob = None
+        if len(blobs) == 3:
+            option_blob = blobs[2]
+        elif self._one_bit and len(blobs) == 4:
+            option_blob = blobs[3]
+        count_event("SHARD_FWD")
+        # BOTH-APPLY: the full add also applies locally (silently, no
+        # ack) — exactly one copy survives: on commit the destination's
+        # (which got the forwarded subset), on rollback the source's
+        # (which applied everything). The ONE ack the requester's
+        # waiter needs comes from the destination carrying the real
+        # msg_id; additional windows (router several epochs behind)
+        # forward with msg_id=-1 — applied, never acked (their Adds'
+        # visibility is the documented at-least-once window).
+        outs: List[Message] = list(
+            self._note_fwd_inflight(msg.src, msg.msg_id, False))
+        first = True
+        for d in sorted({int(x) for x in dst_sid[mask]}):
+            m = mask & (dst_sid == d)
+            rank = int(dst_rank[m][0])
+            fwd = Message(src=msg.src, dst=rank,
+                          msg_type=MsgType.Request_FwdAdd,
+                          table_id=self.table_id,
+                          msg_id=msg.msg_id if first else -1)
+            tid = trace_of(msg)
+            if tid:
+                stamp_trace(fwd, tid)
+            fwd.push(Blob(np.asarray([self._zoo.rank], dtype=np.int64)))
+            fwd.push(Blob(np.ascontiguousarray(
+                keys_eff[m].astype(np.int32)).view(np.uint8)))
+            fwd.push(Blob(np.ascontiguousarray(delta[m])))
+            if option_blob is not None:
+                fwd.push(option_blob)
+            outs.append(fwd)
+            first = False
+        return msg, outs
 
     def _reply_values(self, values) -> List[Blob]:
         """Get replies run through the wire filter for sparse tables
@@ -1838,11 +2489,66 @@ class MatrixServer(ServerTable):
         """Capture under the caller's table lock (see
         ArrayServer.snapshot_state: the updater DONATES the live
         storage away on the next add, so the capture must copy into a
-        fresh device buffer; host transfer happens off-lock)."""
-        return device_lock.settle(self._snapshot(self._data))
+        fresh device buffer; host transfer happens off-lock). Under
+        dynamic ownership the cut additionally copies the migrated-in
+        overlay, the pending-delta ledger and the forwarding windows —
+        the elastic half of the shard's state."""
+        base = device_lock.settle(self._snapshot(self._data))
+        if not self._elastic_active():
+            return base
+        return (base,
+                {k: v.copy() for k, v in self._overlay.items()},
+                {k: v.copy() for k, v in self._pending_delta.items()},
+                list(self._fwd))
+
+    def snapshot_meta(self):
+        """Manifest sidecar (runtime/snapshot.py): the shard-map epoch
+        and this shard's elastic inventory, so a rejoining server
+        restores into the RIGHT map — its payload parses as
+        elastic-format and the controller's re-register re-broadcast
+        re-anchors the epoch (docs/SHARDING.md)."""
+        if not self._elastic_active():
+            return None
+        return {"elastic": 1,
+                "shard_epoch": self._smap.epoch
+                if self._smap is not None else -1,
+                "overlay_rows": len(self._overlay),
+                "fwd": [[int(lo), int(hi), int(sid)]
+                        for lo, hi, sid, _rank in self._fwd]}
 
     def write_snapshot(self, state, stream) -> None:
+        if isinstance(state, tuple):
+            import pickle
+            import struct
+            base, overlay, pending, fwd = state
+            side = pickle.dumps({"overlay": overlay,
+                                 "pending": pending, "fwd": fwd})
+            stream.write(struct.pack("<Q", len(side)))
+            stream.write(side)
+            stream.write(np.asarray(base).tobytes())
+            return
         stream.write(np.asarray(state).tobytes())
+
+    def load_with_meta(self, stream, meta) -> None:
+        if not meta or not meta.get("elastic"):
+            self.load(stream)
+            return
+        import pickle
+        import struct
+        (length,) = struct.unpack("<Q", stream.read(8))
+        side = pickle.loads(stream.read(length))
+        self._overlay = dict(side.get("overlay", {}))
+        self._pending_delta = dict(side.get("pending", {}))
+        self._fwd = [(int(lo), int(hi), int(sid),
+                      self._zoo.server_rank(int(sid)))
+                     for lo, hi, sid, *_ in side.get("fwd", [])]
+        self.load(stream)
+        log.info("rank %d: table %d restored elastic state — %d "
+                 "overlay rows, %d forwarding window(s), recorded "
+                 "shard epoch %s (the controller re-broadcasts the "
+                 "live map on re-register)", self._zoo.rank,
+                 self.table_id, len(self._overlay), len(self._fwd),
+                 meta.get("shard_epoch"))
 
     def load(self, stream) -> None:
         raw = stream.read(self.my_rows * self.num_col * self.dtype.itemsize)

@@ -450,6 +450,53 @@ class WorkerTable:
         self._reply_server = self._reply_version = self._reply_msg_id = -1
         self._reply_replica_rows = 0
 
+    # -- elastic resharding plumbing (runtime/shard_map.py,
+    #    docs/SHARDING.md; worker actor thread) --
+    def apply_shard_map(self, epoch: int, smap, alive_sids) -> None:
+        """Epoch-stamped shard-map broadcast (Control_Shard_Map).
+        Default: tables that don't reshard ignore it."""
+
+    def shard_epoch(self) -> int:
+        """The shard-map epoch this worker has adopted (-1 = still on
+        the frozen creation-time layout). Poll target for
+        ``Zoo.reshard_table``."""
+        return -1
+
+    def shard_owner_sids(self):
+        """Server ids currently owning any of this table's items, or
+        None for tables on the frozen layout."""
+        return None
+
+    def shard_layout(self):
+        """``(bounds, owners)`` lists of the adopted map (None on the
+        frozen layout) — the exact-layout poll target for
+        ``Zoo.reshard_table``."""
+        return None
+
+    def reshard_space(self) -> int:
+        """Size of this table's reshardable item space (rows for
+        matrix tables, hash buckets for KV), or 0 when the table type
+        does not support live resharding."""
+        return 0
+
+    def note_shard_moved(self, old_sid: int) -> None:
+        """Rows moved OFF ``old_sid`` in an adopted map: a moved row's
+        version stamps now come from a DIFFERENT shard counter, which
+        is exactly the server-generation change the PR-6
+        ``VersionTracker.regressed`` machinery invalidates on — reuse
+        that path (drop every cache entry attributed to the old
+        owner; entries compared against the new owner's counter would
+        be meaningless). Called BEFORE the router swaps maps, so the
+        caches' ``server_of`` still attributes the moved rows to the
+        old owner and drops exactly them (plus the old owner's
+        unmoved rows — conservative, and resharding is rare)."""
+        log.info("table %d: rows moved off server shard %d (shard-map "
+                 "epoch change) — treating as a generation change, "
+                 "invalidating client caches for that shard",
+                 self.table_id, old_sid)
+        for cache in self._caches:
+            cache.invalidate_server(old_sid)
+
     # -- hot-shard replication plumbing (runtime/replica.py) --
     def apply_replica_map(self, epoch: int, rows) -> None:
         """Promoted-row map broadcast (worker actor thread). Default:
@@ -463,6 +510,18 @@ class WorkerTable:
     def replica_server_alive(self, server_id: int) -> None:
         """A reply from this server landed — re-include it in replica
         routing (rejoin recovery). Default no-op."""
+
+    def replica_reconcile(self, alive_sids) -> None:
+        """An epoch-stamped map broadcast carried the controller's
+        authoritative live-server view: re-validate the router's dead
+        marks against it (a rejoined server resumes serving replicas
+        without waiting for organic traffic). Default no-op."""
+
+    def reshard_kind(self) -> int:
+        """Initial-layout kind for the controller's planner: 0 =
+        contiguous ranges (matrix ``row_offsets``), 1 = modulo hash
+        buckets (KV)."""
+        return 0
 
     def _stage_repair(self, server_id: int, blobs: List[Blob]) -> None:
         """Record a follow-up shard request toward ``server_id`` for
@@ -505,6 +564,11 @@ class ServerTable:
     """Storage-side shard; lives on every server rank. Serializable
     (ref: table_interface.h:61-75)."""
 
+    #: Both-apply exemption flag for the dual-write window (set by
+    #: the server actor around the deliberate handoff-copy apply;
+    #: tables without elastic support never read it).
+    _in_both_apply = False
+
     #: Whether this table's process_add/process_get dispatch jitted
     #: device programs — those must serialize under the server actor's
     #: process-wide table lock (two in-process server threads
@@ -538,6 +602,87 @@ class ServerTable:
         raise NotImplementedError
 
     def process_get(self, blobs: List[Blob]) -> List[Blob]:
+        raise NotImplementedError
+
+    # -- elastic resharding hooks (runtime/shard_map.py,
+    #    docs/SHARDING.md; server actor thread only). Default: table
+    #    types that do not support live migration refuse/ignore —
+    #    the controller rolls the move back on a refusal. --
+    def shard_begin_out(self, desc) -> bool:
+        """Controller's Request_ShardBegin: start streaming
+        ``[desc.lo, desc.hi)`` to the destination. False = this table
+        type cannot migrate live (sparse dirty bitmaps, stateful
+        updaters, element-range arrays) — the server NACKs and the
+        controller abandons the move."""
+        return False
+
+    def shard_pump(self):
+        """One streaming step: ``(outbound messages, more)``. The
+        server actor re-enqueues a pump message to itself while
+        ``more`` — serving traffic interleaves between chunks."""
+        return [], False
+
+    def shard_import_chunk(self, msg):
+        """Destination side of Request_ShardData; returns outbound
+        messages (retransmit request / Control_Shard_Done)."""
+        return []
+
+    def shard_ack(self, msg):
+        """Source side of Request_ShardAck (retransmit request);
+        returns the re-sent chunks."""
+        return []
+
+    def shard_abort(self, epoch: int):
+        """Controller rollback order: source resumes ownership (drops
+        the forwarding window if the final chunk already left),
+        destination drops partial state. The map never moved, so the
+        pre-migration epoch is the consistent state. Returns outbound
+        messages — the source synthesizes retryable error replies for
+        requests it FORWARDED into the now-dead window (the requester
+        tracked them against THIS rank, so the destination's death
+        sweep can never fail them; without these replies a waiter
+        blocks forever)."""
+        return []
+
+    def shard_announce(self):
+        """Traffic-driven resend hook (destination): re-announce a
+        pending Control_Shard_Done / retransmit request whose last
+        copy may have been lost. Returns outbound messages."""
+        return []
+
+    def apply_shard_map_server(self, epoch: int, smap, alive_sids):
+        """Epoch-stamped map broadcast on the server side: a commit
+        clears migration state (the source KEEPS its forwarding
+        entries — stale routers may still send moved rows here),
+        prunes replica entries for moved rows. Returns outbound
+        messages. Default: ignore."""
+        return []
+
+    def shard_forward_get(self, msg):
+        """Dual-read window routing for an inbound Get: None = serve
+        locally as usual; else a list of outbound messages that fully
+        handle the request (the reply reaches the requester from the
+        destination, carrying this shard's piggybacked rows as a
+        replica group — docs/SHARDING.md)."""
+        return None
+
+    def shard_forward_add(self, msg):
+        """Dual-write routing for an inbound Add: None = apply locally
+        as usual; else ``(local_apply_blobs_or_None, outbound)`` — the
+        moved rows' sub-add forwards to the destination (which acks
+        the requester), any still-owned remainder applies HERE with no
+        ack of its own (the destination's single ack completes the
+        waiter; per-request FIFO toward the destination orders the
+        forwarded add before any later forwarded read)."""
+        return None
+
+    def process_forward_get(self, blobs):
+        """Destination side of Request_FwdGet: serve the forwarded
+        rows, append the piggybacked source rows, and return
+        ``(reply_blobs, n_replica_rows, src_rank, src_version)`` — the
+        server actor builds a Reply_Get IMPERSONATING the source rank
+        (so the requester's in-flight accounting matches the shard it
+        sent) with this shard's rows as the replica group."""
         raise NotImplementedError
 
     # -- hot-shard replication hooks (runtime/replica.py; server actor
@@ -601,6 +746,21 @@ class ServerTable:
         """Serialize a ``snapshot_state`` capture into ``stream`` in
         ``store``-compatible format."""
         stream.write(state)
+
+    def snapshot_meta(self):
+        """JSON-able sidecar recorded in the snapshot MANIFEST entry
+        (runtime/snapshot.py) alongside the payload: reshardable
+        tables record their adopted shard-map epoch + owned intervals
+        here, so a rejoining server restores into the RIGHT map
+        instead of its frozen creation-time layout. None (default) =
+        no sidecar, legacy restore path."""
+        return None
+
+    def load_with_meta(self, stream, meta) -> None:
+        """Restore from a snapshot payload plus its manifest sidecar
+        (``snapshot_meta`` round trip). Default: sidecar-less legacy
+        ``load``."""
+        self.load(stream)
 
     @property
     def zoo(self):

@@ -60,6 +60,14 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "heartbeat_timeout_s": 5.0,
     "rejoin_grace_s": 30.0,
     "connect_timeout_s": 30.0,
+    # -- elastic resharding + chaos harness (runtime/shard_map.py,
+    #    util/chaos.py; docs/SHARDING.md) --
+    "reshard_chunk_rows": 4096,
+    "reshard_auto": False,
+    "reshard_skew": 2.0,
+    "shard_initial_servers": 0,
+    "chaos_frames": "",
+    "chaos_kill_on": "",
     # -- allreduce engine (runtime/allreduce_engine.py) --
     "allreduce_algo": "auto",
     "allreduce_chunk_kb": 512,

@@ -64,6 +64,16 @@ METRIC_NAMES: Dict[str, str] = {
     "REPLICA_REPAIR": "repair requests issued to row owners",
     "REPLICA_STALE": "replica groups rejected below a RYW floor",
     "REPLICA_SYNC": "write-through refreshes fanned out",
+    # -- elastic resharding + chaos harness (runtime/shard_map.py,
+    #    util/chaos.py; docs/SHARDING.md) --
+    "SHARD_MIGRATE_ROWS": "rows/buckets streamed between servers by "
+                          "live migrations",
+    "SHARD_FWD": "requests routed through a dual-read/forwarding "
+                 "window",
+    "SHARD_RETRANSMIT": "migration chunks re-sent after a detected "
+                        "seq gap",
+    "CHAOS_DROPPED": "frames dropped by the -chaos_frames harness",
+    "CHAOS_DELAYED": "frames delayed by the -chaos_frames harness",
     # -- per-destination dispatch queues (runtime/communicator.py) --
     "DISPATCH_MS[d*]": "per-destination dispatch queue latency (ms)",
     "DISPATCH_QUEUE_DEPTH[d*]": "per-destination queue depth at submit",

@@ -96,12 +96,23 @@ class TestFixtures:
         # fixture stay silent; the pragma'd site counts as suppressed.
         assert result.per_pass_suppressed["metric-name"] == 1
 
+    def test_send_discipline_seeded(self):
+        result = _fixture_result("bad_sends.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "send-discipline"]
+        assert len(found) == 2, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert "send_async" in messages
+        # send_async, socket.send and generator.send stay silent; the
+        # pragma'd site counts as suppressed.
+        assert result.per_pass_suppressed["send-discipline"] == 1
+
     def test_fixture_dir_fails_as_a_whole(self):
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 20
-        assert len(result.suppressed) == 5
+        assert len(result.violations) == 22
+        assert len(result.suppressed) == 6
 
 
 class TestCleanTree:
@@ -116,6 +127,26 @@ class TestCleanTree:
         doc = parse_doc_slots(REPO_ROOT / "docs" / "WIRE_FORMAT.md")
         from multiverso_tpu.core.message import WIRE_SLOTS
         assert doc == WIRE_SLOTS
+
+    def test_doc_msg_type_table_matches_registry(self):
+        from multiverso_tpu.core.message import MsgType
+        from tools.mvlint.wire_slot_lint import parse_doc_msg_types
+        doc = parse_doc_msg_types(REPO_ROOT / "docs" / "WIRE_FORMAT.md")
+        enum = {t.name: int(t) for t in MsgType if t.name != "Default"}
+        assert doc == enum
+
+    def test_msg_type_doc_drift_is_a_violation(self, tmp_path):
+        drifted = tmp_path / "WIRE_FORMAT.md"
+        drifted.write_text("| 5 | `ERROR_SLOT` |\n"
+                           "| `Request_Get` | 1 |\n"
+                           "| `Ghost_Type` | 99 |\n")
+        lint = WireSlotLint({"ERROR_SLOT": 5}, drifted,
+                            msg_types={"Request_Get": 1,
+                                       "Request_Add": 2})
+        module = ModuleInfo(FIXTURES / "bad_flags.py", REPO_ROOT)
+        messages = [v.message for v in lint.check(module)]
+        assert any("Request_Add=2 missing" in m for m in messages)
+        assert any("Ghost_Type" in m for m in messages)
 
     def test_doc_metric_table_matches_registry(self):
         from tools.mvlint.metric_lint import (load_metric_names,

@@ -1,6 +1,6 @@
 """mvlint: project-invariant static analysis for the actor/PS runtime.
 
-Five passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+Six passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 (see each module's docstring for the precise rules):
 
 * ``flag-lint`` — every flag access names a canonical registered flag
@@ -15,6 +15,9 @@ Five passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 * ``metric-name`` — every ``monitor``/``samples``/``count`` literal
   names a canonical metric (``util/dashboard.py METRIC_NAMES``,
   cross-checked against the table in ``docs/OBSERVABILITY.md``).
+* ``send-discipline`` — blocking ``net.send`` stays inside the
+  transport layer; liveness/control frames ride ``send_async`` (the
+  PR-6/PR-9 dispatch-thread-starvation class, now machine-checked).
 
 Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
 (``--baseline`` prints per-pass counts without failing). The runtime
@@ -33,7 +36,9 @@ from .flag_lint import FlagLint, load_canonical_flags
 from .framework import LintPass, RunResult, Violation, run_passes
 from .lock_lint import LockDisciplineLint
 from .metric_lint import MetricNameLint, load_metric_names
-from .wire_slot_lint import WireSlotLint, load_wire_slots
+from .send_lint import SendDisciplineLint
+from .wire_slot_lint import (WireSlotLint, load_msg_types,
+                             load_wire_slots)
 
 #: Repo root = two levels above this package (tools/mvlint/__init__.py).
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
@@ -46,14 +51,18 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         root / "multiverso_tpu" / "util" / "configure.py")
     slots = load_wire_slots(
         root / "multiverso_tpu" / "core" / "message.py")
+    msg_types = load_msg_types(
+        root / "multiverso_tpu" / "core" / "message.py")
     metrics = load_metric_names(
         root / "multiverso_tpu" / "util" / "dashboard.py")
     return [
         FlagLint(canonical),
-        WireSlotLint(slots, root / "docs" / "WIRE_FORMAT.md"),
+        WireSlotLint(slots, root / "docs" / "WIRE_FORMAT.md",
+                     msg_types=msg_types),
         DeviceDispatchLint(),
         LockDisciplineLint(),
         MetricNameLint(metrics, root / "docs" / "OBSERVABILITY.md"),
+        SendDisciplineLint(),
     ]
 
 

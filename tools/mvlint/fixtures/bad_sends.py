@@ -1,0 +1,29 @@
+"""Seeded violations for the send-discipline pass (tests/test_mvlint.py
+pins the counts). NOT importable production code."""
+
+
+class Monitor:
+    def __init__(self, zoo, net):
+        self._zoo = zoo
+        self._net = net
+
+    def tick_bad_direct(self, msg):
+        # VIOLATION 1: blocking send of a liveness frame on a net
+        # attribute chain.
+        self._zoo.net.send(msg)
+
+    def tick_bad_own_net(self, msg):
+        # VIOLATION 2: same class through the actor's own _net handle.
+        self._net.send(msg)
+
+    def tick_ok_async(self, msg):
+        self._zoo.net.send_async(msg)  # the required form — silent
+
+    def tick_ok_socket(self, sock, frame):
+        sock.send(frame)  # not a net chain — silent
+
+    def tick_ok_generator(self, gen):
+        gen.send(None)  # coroutine resume — silent
+
+    def tick_suppressed(self, msg):
+        self._net.send(msg)  # mvlint: ignore[send-discipline]

@@ -1,0 +1,85 @@
+"""send-discipline lint: blocking ``net.send`` stays in the transport.
+
+The dispatch-thread-starvation class bit THREE separate times (PR 6
+heartbeats twice, PR 9 metrics; ROADMAP "Recurring theme"): anything
+that routes a liveness/control frame through a path that can BLOCK —
+the communicator's single dispatch thread parked in a
+``-connect_timeout_s`` connect-retry toward a dead peer, or a direct
+blocking ``net.send`` doing the same — starves the frame past
+``-heartbeat_timeout_s`` and the controller declares a perfectly
+healthy rank dead. The fix is always the same: liveness/control frames
+ride non-blocking ``send_async`` (per-destination writer threads).
+This pass enforces it statically so shard-map broadcasts, heartbeats
+and their successors can never reintroduce the class:
+
+* a call whose callee is ``<chain>.send(...)`` where the chain ends in
+  a ``net``/``_net`` attribute or name (``self._zoo.net.send``,
+  ``zoo.net.send``, ``self._net.send``, ``net.send``) is banned
+  OUTSIDE the allowlisted transport/engine modules — everything else
+  must use ``send_async`` (or route through the communicator actor,
+  whose mailbox push never blocks);
+* the transport layer itself (``runtime/net.py``, ``runtime/tcp.py``),
+  the communicator's single outbound tail
+  (``runtime/communicator.py``), the allreduce engine's collective
+  data plane (``runtime/allreduce_engine.py``) and test code are
+  allowlisted — those are the sites where a blocking send is the
+  deliberate backpressure, not an accident;
+* ``x.send_async(...)`` and unrelated ``send`` methods (socket
+  ``sendall``, generator ``send`` on a non-net chain) are not matched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .framework import LintPass, ModuleInfo, Violation
+
+#: Modules where a blocking net send is the transport's own business.
+ALLOWED_SUFFIXES = (
+    "multiverso_tpu/runtime/net.py",
+    "multiverso_tpu/runtime/tcp.py",
+    "multiverso_tpu/runtime/communicator.py",
+    "multiverso_tpu/runtime/allreduce_engine.py",
+)
+
+ALLOWED_PREFIXES = ("tests/",)
+
+NET_NAMES = {"net", "_net"}
+
+
+def _chain_tail(node: ast.AST):
+    """The attribute/name the ``.send`` receiver ends in: for
+    ``self._zoo.net.send`` the receiver is ``self._zoo.net`` and the
+    tail is ``net``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class SendDisciplineLint(LintPass):
+    name = "send-discipline"
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        rel = module.rel
+        if rel.endswith(ALLOWED_SUFFIXES) or \
+                any(rel.startswith(p) for p in ALLOWED_PREFIXES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "send"):
+                continue
+            tail = _chain_tail(fn.value)
+            if tail not in NET_NAMES:
+                continue
+            yield Violation(
+                rel, node.lineno, node.col_offset, self.name,
+                "blocking net.send() outside the transport layer: "
+                "liveness/control frames must ride send_async (the "
+                "dispatch-thread-starvation class, PR-6/PR-9 — "
+                "docs/STATIC_ANALYSIS.md) or route through the "
+                "communicator actor")

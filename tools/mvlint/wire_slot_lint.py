@@ -25,6 +25,45 @@ from .framework import LintPass, ModuleInfo, Violation
 
 DOC_ROW_RE = re.compile(r"^\|\s*(\d+)\s*\|\s*`([A-Z_]+)`\s*\|")
 
+#: A message-type registry row is | `Type_Name` | <int> | — name first
+#: (the slot table is number-first, so the two cannot cross-match).
+DOC_MSG_ROW_RE = re.compile(
+    r"^\|\s*`([A-Za-z][A-Za-z0-9_]*)`\s*\|\s*(-?\d+)\s*\|")
+
+
+def load_msg_types(message_path: Path) -> Dict[str, int]:
+    """The MsgType enum values, by AST parse of core/message.py (the
+    lint parses, it never imports)."""
+    tree = ast.parse(message_path.read_text(encoding="utf-8"))
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "MsgType":
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    try:
+                        out[stmt.targets[0].id] = int(
+                            ast.literal_eval(stmt.value))
+                    except (ValueError, TypeError):
+                        pass
+    if not out:
+        raise RuntimeError(f"no MsgType enum in {message_path}")
+    return out
+
+
+def parse_doc_msg_types(doc_path: Path) -> Dict[str, int]:
+    """``| `Request_Get` | 1 |`` rows from the doc's message-type
+    registry table."""
+    out: Dict[str, int] = {}
+    if not doc_path.exists():
+        return out
+    for line in doc_path.read_text(encoding="utf-8").splitlines():
+        m = DOC_MSG_ROW_RE.match(line.strip())
+        if m:
+            out[m.group(1)] = int(m.group(2))
+    return out
+
 
 def load_wire_slots(message_path: Path) -> Dict[str, int]:
     """The WIRE_SLOTS literal, by AST parse of core/message.py."""
@@ -59,10 +98,14 @@ class WireSlotLint(LintPass):
     name = "wire-slot"
 
     def __init__(self, slots: Dict[str, int], doc_path: Path,
-                 doc_rel: str = "docs/WIRE_FORMAT.md"):
+                 doc_rel: str = "docs/WIRE_FORMAT.md",
+                 msg_types: Optional[Dict[str, int]] = None):
         self.slots = slots
         self.doc_path = doc_path
         self.doc_rel = doc_rel
+        #: MsgType enum values (None = skip the msg-type doc check —
+        #: unit tests exercising only the slot half pass None).
+        self.msg_types = msg_types
         self._doc_checked = False
 
     def check(self, module: ModuleInfo) -> Iterator[Violation]:
@@ -126,3 +169,35 @@ class WireSlotLint(LintPass):
                     self.doc_rel, 1, 0, self.name,
                     f"doc documents slot {name}={slot} which is not in "
                     f"core/message.py WIRE_SLOTS — stale doc entry")
+        yield from self._check_doc_msg_types()
+
+    def _check_doc_msg_types(self) -> Iterator[Violation]:
+        """Both-direction cross-check of the doc's message-type
+        registry table against the MsgType enum (the slot-8/9
+        precedent, extended to types: a new control message that never
+        lands in the doc, or a stale doc row, is a violation)."""
+        if self.msg_types is None:
+            return
+        doc = parse_doc_msg_types(self.doc_path)
+        for name, value in sorted(self.msg_types.items()):
+            if name == "Default":
+                continue  # the unset header value, not a wire type
+            if name not in doc:
+                yield Violation(
+                    self.doc_rel, 1, 0, self.name,
+                    f"message type {name}={value} missing from the "
+                    f"doc's message-type registry table "
+                    f"(| `{name}` | {value} | row)")
+            elif doc[name] != value:
+                yield Violation(
+                    self.doc_rel, 1, 0, self.name,
+                    f"doc says {name} is {doc[name]} but "
+                    f"core/message.py MsgType says {value} — the doc "
+                    f"drifted from the wire")
+        for name, value in sorted(doc.items()):
+            if name not in self.msg_types:
+                yield Violation(
+                    self.doc_rel, 1, 0, self.name,
+                    f"doc documents message type {name}={value} which "
+                    f"is not in core/message.py MsgType — stale doc "
+                    f"entry")
