@@ -107,6 +107,18 @@ echo "== serving subset (frontend / admission / staleness invariant) =="
 # docs/SERVING.md).
 python -m pytest tests/test_serving.py -x -q -m 'not slow'
 
+echo "== serving-fleet subset (scatter-gather / batching / hot cache / ANN) =="
+# The fleet read path gets its own named gate: scatter-gather reads
+# with row-scoped partial-failure containment (dead shard owner ->
+# retryable 503 on exactly the affected rows, never a wrong value),
+# request-batching boundaries (window-deadline vs size-cap flush, the
+# lone-request latency bound, batch error isolation), hot-response-
+# cache freshness + the data-generation forced invalidation
+# (reshard/rejoin), the IVF neighbors index (exactness at full probe,
+# recall, the brute=1 escape), and the /v1/status fleet view
+# (tests/test_serving_fleet.py; docs/SERVING.md fleet section).
+python -m pytest tests/test_serving_fleet.py -x -q -m 'not slow'
+
 echo "== fault-tolerance subset (snapshots / rejoin / backup workers) =="
 # Crash-survival invariants get their own named gate: async snapshot
 # consistency + restore, dead-peer containment and retry, the BSP

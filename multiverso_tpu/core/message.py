@@ -106,6 +106,18 @@ class MsgType(enum.IntEnum):
     Control_Shard_Request = 40
     Control_Shard_Tick = 41
     Control_Shard_Map = -39
+    # Serving-fleet pressure exchange (docs/SERVING.md fleet section):
+    # each serving frontend periodically reports its admission stats
+    # ([rank, admitted, shed, inflight] int64 blob) to the controller
+    # (controller band, >32); the controller answers the reporter with
+    # the fleet-aggregate view as a JSON blob (below the worker band,
+    # intercepted BY NAME in the communicator's routing like
+    # Control_Reply_Heartbeat — it must not fall through to the Zoo
+    # mailbox where a blocked barrier would consume it). Both
+    # directions ride net.send_async (the liveness-frame discipline —
+    # mvlint pass 6).
+    Control_Serving_Report = 42
+    Control_Reply_Serving = -42
 
 HEADER_SIZE = 10  # ints (8 in the reference; slot 8 added for
 #                   replication, slot 9 for request tracing)

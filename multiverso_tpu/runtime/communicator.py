@@ -423,6 +423,23 @@ class Communicator(Actor):
         if msg_type == int(MsgType.Control_Reply_Heartbeat):
             self._zoo.note_controller_alive()
             return
+        if msg_type == int(MsgType.Control_Reply_Serving):
+            # Fleet-aggregate serving pressure from the controller
+            # (docs/SERVING.md fleet section): parsed here and stored
+            # on the zoo for /v1/status — like the heartbeat reply, it
+            # must not fall through to the Zoo mailbox.
+            try:
+                import json
+                doc = json.loads(bytes(
+                    msg.data[0].as_array(np.uint8)).decode())
+            except Exception:  # noqa: BLE001 - a malformed aggregate
+                # must not kill the recv thread; the next report
+                # replaces it
+                log.error("rank %d: undecodable serving-fleet reply",
+                          self._zoo.rank)
+                return
+            self._zoo.note_serving_fleet(doc)
+            return
         if msg_type == int(MsgType.Control_Dead_Peer):
             dead = int(msg.data[0].as_array(np.int32)[0]) if msg.data \
                 else -1

@@ -116,6 +116,12 @@ class Controller(Actor):
                               self._process_shard_request)
         self.register_handler(MsgType.Control_Shard_Tick,
                               self._process_shard_tick)
+        # Serving-fleet pressure (docs/SERVING.md fleet section):
+        # per-frontend admission stats, aggregated and echoed back so
+        # every frontend's /v1/status can expose the fleet view.
+        self._serving_fleet: Dict[int, tuple] = {}
+        self.register_handler(MsgType.Control_Serving_Report,
+                              self._process_serving_report)
 
     def _process_shard_done(self, msg: Message) -> None:
         self._note_alive(msg.src)
@@ -171,6 +177,67 @@ class Controller(Actor):
                       "rank %d", msg.src)
             return
         self.metrics.ingest(payload)
+
+    #: A frontend whose report is older than this drops out of the
+    #: fleet aggregate (it stopped, or its rank died — the aggregate
+    #: must not advertise capacity that is gone).
+    _FLEET_STALE_S = 15.0
+
+    def _process_serving_report(self, msg: Message) -> None:
+        """One frontend's admission pressure ([rank, admitted, shed,
+        inflight] int64). Record it, prune stale reporters, and echo
+        the fleet aggregate back to the reporter — via send_async (the
+        heartbeat-reply discipline: the communicator mailbox can park
+        toward a dead peer), or directly into the zoo when the
+        reporter shares this rank."""
+        self._note_alive(msg.src)
+        if not msg.data:
+            return
+        stats = msg.data[0].as_array(np.int64)
+        if stats.size < 4:
+            return
+        now = time.monotonic()
+        self._serving_fleet[int(stats[0])] = (
+            int(stats[1]), int(stats[2]), int(stats[3]), now)
+        for rank in [r for r, ent in self._serving_fleet.items()
+                     if now - ent[3] > self._FLEET_STALE_S]:
+            del self._serving_fleet[rank]
+        doc = self.serving_fleet_view()
+        if msg.src == self._zoo.rank:
+            self._zoo.note_serving_fleet(doc)
+            return
+        import json
+        reply = Message(src=self._zoo.rank, dst=msg.src,
+                        msg_type=MsgType.Control_Reply_Serving)
+        reply.push(Blob(np.frombuffer(
+            json.dumps(doc).encode(), dtype=np.uint8)))
+        try:
+            self._zoo.net.send_async(reply)
+        except Exception as exc:  # noqa: BLE001 - an unreachable
+            # reporter will re-report or be declared dead
+            log.debug("controller: fleet reply to rank %d failed: %s",
+                      msg.src, exc)
+
+    def serving_fleet_view(self) -> dict:
+        """Fleet-aggregate admission pressure (controller actor
+        thread; also read by the local zoo for /v1/status on the
+        controller rank — plain dict build over GIL-atomic reads)."""
+        now = time.monotonic()
+        frontends = {
+            str(rank): {"admitted": adm, "shed": shed,
+                        "inflight": inf,
+                        "age_s": round(now - ts, 3)}
+            for rank, (adm, shed, inf, ts)
+            in sorted(self._serving_fleet.items())}
+        return {
+            "frontends": frontends,
+            "aggregate": {
+                "frontends": len(frontends),
+                "admitted": sum(f["admitted"]
+                                for f in frontends.values()),
+                "shed": sum(f["shed"] for f in frontends.values()),
+                "inflight": sum(f["inflight"]
+                                for f in frontends.values())}}
 
     # -- liveness bookkeeping --
     def _note_alive(self, rank: int) -> None:

@@ -112,6 +112,11 @@ class Zoo:
         self._metrics_http = None
         # -- online serving tier (serving/frontend.py, docs/SERVING.md) --
         self._serving = None
+        # Last fleet-aggregate serving-pressure view received from the
+        # controller (Control_Reply_Serving; written by the
+        # communicator recv thread or the controller actor, read by
+        # /v1/status handler threads — tuple assignment, GIL-atomic).
+        self._serving_fleet: Optional[tuple] = None
 
     # -- lifecycle (ref: src/zoo.cpp:41-60) --
     def start(self, argv: Optional[List[str]] = None,
@@ -429,6 +434,22 @@ class Zoo:
     def note_controller_alive(self) -> None:
         """A heartbeat reply arrived (communicator routing)."""
         self._last_controller_reply = time.monotonic()
+
+    # -- serving-fleet pressure (serving/frontend.py, docs/SERVING.md)
+    def note_serving_fleet(self, doc: dict) -> None:
+        """A fleet-aggregate view arrived (Control_Reply_Serving via
+        the communicator's by-name routing, or directly from a
+        co-located controller actor)."""
+        self._serving_fleet = (doc, time.monotonic())
+
+    def serving_fleet(self) -> Optional[dict]:
+        """The last fleet-aggregate serving-pressure view, stamped
+        with its local age — None until a report round-trips."""
+        ent = self._serving_fleet
+        if ent is None:
+            return None
+        doc, ts = ent
+        return {**doc, "age_s": round(time.monotonic() - ts, 3)}
 
     def controller_silent_for(self) -> float:
         return time.monotonic() - self._last_controller_reply

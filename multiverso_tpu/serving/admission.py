@@ -30,7 +30,8 @@ import itertools
 import time
 from typing import Callable, Dict, Optional
 
-from ..util.configure import define_double, define_int, get_flag
+from ..util.configure import (define_bool, define_double, define_int,
+                              get_flag)
 from ..util.dashboard import count as count_event
 from ..util.lock_witness import named_condition, named_lock
 
@@ -68,6 +69,49 @@ define_double("serving_drain_s", 5.0,
               "requests are rejected (503) immediately, in-flight ones "
               "get up to this many seconds to finish before the HTTP "
               "server closes")
+define_bool("serving_scatter", True,
+            "serve multi-row reads through the concurrent scatter-"
+            "gather read path (read_rows_scatter: per-shard-owner "
+            "sub-requests, partial-failure containment, request "
+            "batching). false = the serialized PR-10 per-request "
+            "read_rows_versioned path (A/B escape hatch)")
+define_double("serving_batch_window_ms", 2.0,
+              "request-batching window on the serving frontend's rows "
+              "endpoint: concurrent reads arriving within this many "
+              "ms fold into ONE scatter-gather table read (one device "
+              "gather per shard per batch instead of per request). "
+              "0 = no batching, each request issues its own scatter "
+              "read (still concurrent-safe)")
+define_int("serving_batch_max_rows", 1024,
+           "size cap on one serving read batch, in merged unique "
+           "rows: a batch reaching it flushes immediately instead of "
+           "waiting out the window (bounds per-gather payload and "
+           "worst-case head-of-line latency)")
+define_int("serving_hot_rows", 4096,
+           "row capacity of the serving frontend's hot-response "
+           "cache: per-row rendered responses keyed on (table, row, "
+           "served_version), served without touching the worker "
+           "table while fresh within the staleness bound (and the "
+           "data generation — reshard/rejoin force-invalidate). "
+           "0 disables it")
+define_double("serving_fleet_interval_s", 2.0,
+              "how often a serving frontend reports its admission "
+              "pressure to the controller and refreshes the fleet-"
+              "aggregate view /v1/status exposes (rank identity + "
+              "fleet-wide in-flight/shed counters, for external load "
+              "balancers). 0 disables fleet reporting")
+define_int("ann_nlist", 0,
+           "IVF coarse-quantizer cluster count for the serving "
+           "neighbors endpoint: > 0 replaces the O(rows x dims) "
+           "linear cosine scan with an inverted-file search over the "
+           "same staleness-bounded snapshot (k-means over unit "
+           "vectors, rebuilt with the index). 0 (default) keeps the "
+           "exact brute-force scan")
+define_int("ann_nprobe", 8,
+           "how many IVF clusters a neighbors query scans (recall/"
+           "latency knob; per-request override via ?nprobe=). Clamped "
+           "to -ann_nlist; brute=1 on the query string bypasses the "
+           "index entirely")
 
 #: Metric names (util/dashboard.py METRIC_NAMES).
 SHED = "SERVING_SHED"

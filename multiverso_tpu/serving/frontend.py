@@ -53,10 +53,14 @@ from ..util.dashboard import samples
 # eagerly by the zoo for parse-time registration; this module pulls in
 # the io/ stack and cannot be imported that early).
 from .admission import AdmissionController, ShedError
+from .ann import IVFIndex
+from .batch import BatchedTableReader, HotRowCache, UpstreamReadError
 
 #: Metric names (util/dashboard.py METRIC_NAMES).
 REQUESTS = "SERVING_REQUESTS"
 LATENCY_MS = "SERVING_LATENCY_MS"
+CACHE_HIT = "SERVING_CACHE_HIT"
+ANN_PROBE_MS = "ANN_PROBE_MS"
 
 #: Neighbor-endpoint k cap: top-k over the full table is O(rows) per
 #: request regardless of k, but an unbounded k makes response bodies
@@ -67,17 +71,21 @@ MAX_NEIGHBORS = 64
 #: serving package stays runtime-import-free (the zoo imports THIS
 #: module eagerly for flag registration; an import back into runtime/
 #: would cycle).
-_SERVER, _WORKER, _COMMUNICATOR = "server", "worker", "communicator"
+_SERVER, _WORKER, _COMMUNICATOR, _CONTROLLER = (
+    "server", "worker", "communicator", "controller")
 
 
 class _ServedTable:
     """Registry entry: a worker-table handle plus the serving-side
-    per-table state — the serialization lock (one Get in flight per
-    table is the table contract) and the lazily refreshed
-    nearest-neighbor index."""
+    per-table state — the index lock (whole-table snapshot fetches
+    still ride the table's one-get-in-flight registers), the batched
+    scatter reader + hot-response cache (serving/batch.py), and the
+    lazily refreshed nearest-neighbor index (brute snapshot + the
+    optional IVF structure over it, serving/ann.py)."""
 
     __slots__ = ("name", "table", "vocab", "words", "lock",
-                 "index_version", "index_values", "index_norms")
+                 "index_version", "index_generation", "index_values",
+                 "index_norms", "ivf", "reader", "hot")
 
     def __init__(self, name: str, table, vocab: Optional[Dict[str, int]]):
         self.name = name
@@ -91,8 +99,12 @@ class _ServedTable:
                     self.words[int(row)] = word
         self.lock = threading.Lock()
         self.index_version = -1
+        self.index_generation = -1
         self.index_values: Optional[np.ndarray] = None
         self.index_norms: Optional[np.ndarray] = None
+        self.ivf: Optional[IVFIndex] = None
+        self.reader: Optional[BatchedTableReader] = None
+        self.hot: Optional[HotRowCache] = None
 
 
 class ServingFrontend(HttpServer):
@@ -102,11 +114,26 @@ class ServingFrontend(HttpServer):
         self._tables: Dict[str, _ServedTable] = {}
         self._tables_lock = threading.Lock()
         self._max_rows = int(get_flag("serving_max_rows", 4096))
+        self._scatter = bool(get_flag("serving_scatter", True))
+        self._ann_nlist = int(get_flag("ann_nlist", 0))
+        self._ann_nprobe = int(get_flag("ann_nprobe", 8))
         self.admission = AdmissionController(
             depth_of=self._mailbox_depth)
         super().__init__(
             int(get_flag("serving_port", 0)) if port is None else port,
             self._resolve_path, host=host, name="serving")
+        # Fleet-pressure reporting (docs/SERVING.md fleet section):
+        # ship this frontend's admission stats to the controller on a
+        # cadence; the reply carries the fleet aggregate /v1/status
+        # exposes for external load balancers.
+        self._fleet_stop = threading.Event()
+        self._fleet_thread: Optional[threading.Thread] = None
+        interval = float(get_flag("serving_fleet_interval_s", 2.0))
+        if interval > 0:
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_main, args=(interval,),
+                daemon=True, name=f"mv-serving-fleet-{self.port}")
+            self._fleet_thread.start()
 
     # -- registry --
     def register_table(self, name: str, table,
@@ -120,10 +147,20 @@ class ServingFrontend(HttpServer):
                 f"table {name!r} ({type(table).__name__}) does not "
                 f"support serving reads (read_rows_versioned) — only "
                 f"dense matrix worker tables serve (docs/SERVING.md)")
+        entry = _ServedTable(name, table, vocab)
+        if self._scatter and hasattr(table, "read_rows_scatter") \
+                and not getattr(table, "is_sparse", False):
+            entry.reader = BatchedTableReader(
+                name, table, lambda t=table: self._bound_of_table(t))
+            if int(get_flag("serving_hot_rows", 4096)) > 0 \
+                    and hasattr(table, "cache_generation"):
+                entry.hot = HotRowCache(
+                    table, lambda t=table: self._bound_of_table(t))
         with self._tables_lock:
-            self._tables[name] = _ServedTable(name, table, vocab)
-        log.info("serving: table %r registered (%d x %d)", name,
-                 table.num_row, table.num_col)
+            self._tables[name] = entry
+        log.info("serving: table %r registered (%d x %d, scatter=%s, "
+                 "hot_cache=%s)", name, table.num_row, table.num_col,
+                 entry.reader is not None, entry.hot is not None)
 
     # -- pressure probe (admission's depth gate) --
     def _mailbox_depth(self) -> int:
@@ -196,10 +233,19 @@ class ServingFrontend(HttpServer):
                              "num_col": int(e.table.num_col),
                              "vocab": e.vocab is not None}
                       for name, e in self._tables.items()}
+        # Rank identity + the controller-aggregated fleet view: behind
+        # a load balancer every frontend answers /v1/status, and
+        # without these fields the ranks are indistinguishable and
+        # only LOCAL pressure is visible (docs/SERVING.md fleet
+        # section). fleet is None until the first report round trips
+        # (or with -serving_fleet_interval_s=0).
+        fleet = getattr(self._zoo, "serving_fleet", None)
         return json_response({
+            "rank": int(self._zoo.rank),
             "tables": tables,
             "admission": self.admission.stats(),
-            "mailboxes": self._mailbox_report()})
+            "mailboxes": self._mailbox_report(),
+            "fleet": fleet() if callable(fleet) else None})
 
     def _list_tables(self, query) -> Response:
         with self._tables_lock:
@@ -234,15 +280,62 @@ class ServingFrontend(HttpServer):
         self._admit("rows")
         t0 = time.perf_counter()
         try:
-            with entry.lock:
-                values, meta = entry.table.read_rows_versioned(ids)
+            # Hot-response cache first: the Zipf head serves straight
+            # from rendered rows — no table call, no device, not even
+            # the ndarray->list prep (serving/batch.py HotRowCache;
+            # freshness = staleness bound + data generation).
+            if entry.hot is not None:
+                served = entry.hot.lookup(ids)
+                if served is not None:
+                    rendered, meta = served
+                    count_event(CACHE_HIT)
+                    return self._rows_response(
+                        name, ids, rendered, meta, t0,
+                        response_cache="hit")
+            if entry.reader is not None:
+                try:
+                    values, meta, detail = entry.reader.read(ids)
+                except UpstreamReadError as exc:
+                    # Row-scoped upstream failure (dead shard owner /
+                    # timeout): typed retryable rejection naming
+                    # exactly the affected rows — rows on healthy
+                    # shards in OTHER requests of the same batch were
+                    # served normally, and a wrong value is never
+                    # substituted.
+                    retry = self.admission.retry_after_s
+                    if exc.retryable:
+                        raise HttpError(
+                            503, str(exc),
+                            headers={"Retry-After": str(max(
+                                int(math.ceil(retry)), 1))},
+                            extra={"retry_after_s": retry,
+                                   "failed_rows": exc.rows,
+                                   "retryable": True}) from exc
+                    raise HttpError(
+                        500, str(exc),
+                        extra={"failed_rows": exc.rows,
+                               "retryable": False}) from exc
+                if entry.hot is not None:
+                    entry.hot.store(detail)
+                rendered = np.asarray(values).tolist()
+            else:
+                # -serving_scatter=false escape hatch: the serialized
+                # PR-10 one-get-in-flight path.
+                with entry.lock:
+                    values, meta = entry.table.read_rows_versioned(ids)
+                rendered = np.asarray(values).tolist()
+            return self._rows_response(name, ids, rendered, meta, t0)
         finally:
             self.admission.release("rows")
+
+    def _rows_response(self, name: str, ids: np.ndarray,
+                       rendered: List, meta: dict, t0: float,
+                       response_cache: str = "miss") -> Response:
         samples(LATENCY_MS).add((time.perf_counter() - t0) * 1e3)
         count_event(REQUESTS)
         return json_response(
-            {"table": name, "ids": ids.tolist(),
-             "rows": np.asarray(values).tolist(), **meta},
+            {"table": name, "ids": ids.tolist(), "rows": rendered,
+             "response_cache": response_cache, **meta},
             headers=self._meta_headers(meta))
 
     @staticmethod
@@ -283,6 +376,12 @@ class ServingFrontend(HttpServer):
             if not 0 <= row < entry.table.num_row:
                 raise HttpError(400, f"row id {row} out of range "
                                      f"[0, {entry.table.num_row})")
+        brute = query.get("brute") == "1"
+        try:
+            nprobe = int(query.get("nprobe", self._ann_nprobe))
+        except ValueError:
+            raise HttpError(400, f"unparseable nprobe "
+                                 f"{query.get('nprobe')!r}") from None
         self._admit("neighbors")
         t0 = time.perf_counter()
         try:
@@ -291,22 +390,39 @@ class ServingFrontend(HttpServer):
                 values = entry.index_values
                 norms = entry.index_norms
                 index_version = entry.index_version
-            # Scoring stays INSIDE the admission bracket: the
-            # O(rows x cols) cosine matmul + top-k is this endpoint's
-            # dominant cost, and releasing before it would let an
-            # unbounded number of scoring threads run concurrently —
-            # exactly the accepted-p99 convoy the in-flight cap exists
-            # to prevent.
+                ivf = entry.ivf
+            # Scoring stays INSIDE the admission bracket: the scan
+            # (IVF probe or the O(rows x cols) brute matmul) + top-k
+            # is this endpoint's dominant cost, and releasing before
+            # it would let an unbounded number of scoring threads run
+            # concurrently — exactly the accepted-p99 convoy the
+            # in-flight cap exists to prevent.
             q = values[row]
-            qn = float(np.linalg.norm(q))
-            scores = (values @ q) / (norms * max(qn, 1e-12))
-            scores[row] = -np.inf  # the query is not its own neighbor
-            top = np.argpartition(-scores, min(k, scores.size - 1))[:k]
-            top = top[np.argsort(-scores[top])]
+            if ivf is not None and not brute:
+                # Probe-only timing: t0 would fold in the lock wait
+                # and any index REBUILD (a whole-table fetch +
+                # k-means), burying probe-latency regressions.
+                t_probe = time.perf_counter()
+                top_ids, top_scores, scanned = ivf.search(
+                    q, k, nprobe, exclude=row)
+                samples(ANN_PROBE_MS).add(
+                    (time.perf_counter() - t_probe) * 1e3)
+                index_kind = {"kind": "ivf", "nlist": ivf.nlist,
+                              "nprobe": min(max(nprobe, 1), ivf.nlist),
+                              "candidates": scanned}
+            else:
+                qn = float(np.linalg.norm(q))
+                scores = (values @ q) / (norms * max(qn, 1e-12))
+                scores[row] = -np.inf  # not its own neighbor
+                top = np.argpartition(-scores,
+                                      min(k, scores.size - 1))[:k]
+                top_ids = top[np.argsort(-scores[top])]
+                top_scores = scores[top_ids]
+                index_kind = {"kind": "brute",
+                              "candidates": int(scores.size)}
             neighbors = []
-            for i in top:
-                item = {"id": int(i),
-                        "score": round(float(scores[i]), 6)}
+            for i, s in zip(top_ids, top_scores):
+                item = {"id": int(i), "score": round(float(s), 6)}
                 if entry.words is not None \
                         and entry.words[int(i)] is not None:
                     item["word"] = entry.words[int(i)]
@@ -326,24 +442,40 @@ class ServingFrontend(HttpServer):
             {"table": name,
              "query": {"id": int(row),
                        **({"word": word} if word is not None else {})},
-             "k": k, "neighbors": neighbors,
+             "k": k, "neighbors": neighbors, "index": index_kind,
              "index_refreshed": bool(refreshed), **meta},
             headers=self._meta_headers(meta))
 
     @staticmethod
-    def _bound_of(entry: _ServedTable) -> int:
-        cache = getattr(entry.table, "_row_cache", None)
+    def _bound_of_table(table) -> int:
+        cache = getattr(table, "_row_cache", None)
         return int(cache.bound) if cache is not None else 0
+
+    @classmethod
+    def _bound_of(cls, entry: _ServedTable) -> int:
+        return cls._bound_of_table(entry.table)
+
+    @staticmethod
+    def _generation_of(entry: _ServedTable) -> int:
+        gen = getattr(entry.table, "cache_generation", None)
+        return int(gen()) if callable(gen) else 0
 
     def _refresh_index(self, entry: _ServedTable) -> bool:
         """Refresh the neighbor index when it has aged past the
         staleness bound — the SAME freshness rule the row cache
         applies, lifted to the whole-table snapshot: an index built
         when the newest observed shard version was ``v`` serves while
-        ``latest - v <= bound``. Caller holds ``entry.lock``."""
+        ``latest - v <= bound`` — OR when the table's data generation
+        changed (elastic reshard / server rejoin). Version staleness
+        alone misses the latter: a restored or remapped shard's
+        counter can restart BELOW the index anchor, so ``latest -
+        index_version`` stays negative forever while the underlying
+        rows change arbitrarily. Caller holds ``entry.lock``."""
         latest = max(entry.table.observed_versions().values(),
                      default=-1)
+        generation = self._generation_of(entry)
         if entry.index_values is not None \
+                and generation == entry.index_generation \
                 and latest - entry.index_version <= \
                 self._bound_of(entry):
             return False
@@ -353,24 +485,78 @@ class ServingFrontend(HttpServer):
         # with add-acks that landed mid-fetch — under a concurrent
         # trainer the index would then serve past the bound
         # undetected and served_version would overstate the snapshot.
+        # The generation is pre-anchored for the same reason: a
+        # reshard landing mid-fetch must invalidate THIS build.
         entry.index_version = latest
+        entry.index_generation = generation
         values = np.array(self._fetch_all(entry), copy=True)
         entry.index_values = values
         norms = np.linalg.norm(values, axis=1)
         entry.index_norms = np.maximum(norms, 1e-12)
+        entry.ivf = None
+        if self._ann_nlist > 0:
+            t0 = time.perf_counter()
+            entry.ivf = IVFIndex(values, entry.index_norms,
+                                 self._ann_nlist)
+            log.debug("serving: IVF index for %r rebuilt (%d lists, "
+                      "%.1f ms)", entry.name, entry.ivf.nlist,
+                      (time.perf_counter() - t0) * 1e3)
         return True
 
     @staticmethod
     def _fetch_all(entry: _ServedTable) -> np.ndarray:
         return entry.table.get()
 
+    # -- fleet-pressure reporting (docs/SERVING.md fleet section) --
+    def _fleet_main(self, interval: float) -> None:
+        """Reporter thread: every ``interval`` ship this frontend's
+        admission pressure to the controller (Control_Serving_Report)
+        and let the reply refresh the zoo's fleet-aggregate view.
+        Frames ride ``net.send_async`` — never the communicator
+        mailbox, whose dispatch thread can park toward a dead peer
+        (the PR-6 liveness-frame discipline)."""
+        while not self._fleet_stop.wait(timeout=interval):
+            try:
+                self._send_fleet_report()
+            except Exception as exc:  # noqa: BLE001 - reporting is
+                # best-effort; a hiccup must not kill the thread
+                log.debug("serving: fleet report failed: %s", exc)
+
+    def _send_fleet_report(self) -> None:
+        from ..core.blob import Blob
+        from ..core.message import Message, MsgType
+        from ..runtime.zoo import CONTROLLER_RANK
+        stats = self.admission.stats()
+        msg = Message(src=self._zoo.rank, dst=CONTROLLER_RANK,
+                      msg_type=MsgType.Control_Serving_Report)
+        msg.push(Blob(np.asarray(
+            [self._zoo.rank, stats["admitted"], stats["shed"],
+             sum(stats["inflight"].values())], dtype=np.int64)))
+        if self._zoo.rank == CONTROLLER_RANK:
+            controller = self._zoo._actors.get(_CONTROLLER)
+            if controller is not None:
+                controller.receive(msg)
+        else:
+            self._zoo.net.send_async(msg)
+
     # -- lifecycle --
     def stop(self) -> None:
         """Graceful drain, then close: new requests reject with 503
         immediately; in-flight ones get up to ``-serving_drain_s``."""
+        self._fleet_stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=5)
+            self._fleet_thread = None
         drained = self.admission.begin_drain()
         if not drained:
             log.error("serving: drain timed out with requests still "
                       "in flight — closing anyway (%s)",
                       self.admission.stats()["inflight"])
+        # Batcher threads stop AFTER the drain: in-flight requests may
+        # still be parked on a batch that must execute.
+        with self._tables_lock:
+            entries = list(self._tables.values())
+        for entry in entries:
+            if entry.reader is not None:
+                entry.reader.stop()
         super().stop()

@@ -56,7 +56,9 @@ from ..util import log, wire_codec
 from ..util.configure import define_bool, get_flag
 from ..util.log import CHECK
 from ..util.quantization import OneBitFilter
-from .table_interface import ServerTable, WorkerTable
+from .table_interface import (RpcTimeoutError, ServerTable,
+                              TableRequestError, WorkerTable)
+from ..runtime.net import PeerLostError
 
 define_bool("sparse_compress", True,
             "run sparse-matrix wire traffic through the compact wire "
@@ -181,6 +183,23 @@ def row_offsets(num_row: int, num_servers: int) -> List[int]:
     return offsets
 
 
+class _ScatterRead:
+    """One in-flight scatter-gather serving read (docs/SERVING.md):
+    ``rows`` is the SORTED UNIQUE requested id vector; sub-request
+    replies (worker actor thread) place values and per-row fetch
+    versions at ``searchsorted`` positions. Requester threads read the
+    buffers only after every sub-request's waiter completed, so no
+    locking is needed — each reply writes a disjoint position set."""
+
+    __slots__ = ("rows", "out", "versions")
+
+    def __init__(self, rows: np.ndarray, out: np.ndarray,
+                 versions: np.ndarray):
+        self.rows = rows
+        self.out = out
+        self.versions = versions
+
+
 @dataclass
 class MatrixTableOption:
     """ref: include/multiverso/table/matrix.h:116-123."""
@@ -286,6 +305,14 @@ class MatrixWorker(WorkerTable):
         self._pf_rows: Dict[int, np.ndarray] = {}
         self._pf_by_key: Dict[bytes, int] = {}
         self._pf_joined: Dict[int, List] = {}
+        # Scatter-gather serving reads (read_rows_scatter,
+        # docs/SERVING.md): msg_id -> _ScatterRead. Each sub-request
+        # carries its OWN destination buffer, so any number may be in
+        # flight concurrently — unlike the one-get-in-flight _dest
+        # registers. Registered on the requester thread BEFORE the
+        # send, read on the worker actor thread (dict get/pop,
+        # GIL-atomic; registration happens-before the mailbox push).
+        self._sg: Dict[int, _ScatterRead] = {}
         # Hot-shard read replication routing (runtime/replica.py,
         # docs/SHARDING.md): the promoted-row map re-routes the
         # replicated subset of a host row Get to holder servers
@@ -532,6 +559,114 @@ class MatrixWorker(WorkerTable):
             "cache_hit": bool(cache_hit),
             "rows_requested": int(uniq.size),
             "rows_cached": int(rows_cached)}
+
+    def read_rows_scatter(self, row_ids):
+        """Concurrent scatter-gather serving read (docs/SERVING.md
+        fleet section): unlike ``get_rows``/``read_rows_versioned`` —
+        which share the table's one-get-in-flight destination
+        registers and therefore serialize — each call owns its buffers
+        end to end, so any number of serving threads may read
+        concurrently while a trainer Adds.
+
+        The missing (cache-cold) rows fan out as ONE sub-request per
+        owning server shard; ``partition`` routes each exactly as a
+        normal Get (replica striping, repair machinery, version
+        stamps all apply), but a failure — dead shard owner, RPC
+        timeout — is contained to that sub-request's row group
+        instead of failing the whole read.
+
+        Returns ``(values, info)``: ``values`` is ``[n, num_col]``
+        over the SORTED UNIQUE requested rows ``info["rows"]``;
+        ``info`` additionally carries per-row ``versions`` (fetch
+        version, -1 = failed/unstamped), ``owners`` (owning server
+        ids at issue time), ``cached`` (served locally), the
+        pre-fetch ``latest_by_sid`` snapshot (read BEFORE any fetch,
+        the ``read_rows_versioned`` anchoring rule, so per-row
+        ``latest_by_sid[owner] - version <= staleness bound`` is
+        race-free under concurrent Adds), ``failed`` (sorted unique
+        row ids whose sub-request failed — their positions in
+        ``values`` are UNDEFINED), ``failed_fatal`` (the subset whose
+        failure was NOT a typed retryable one — callers map per-row:
+        retryable rows back off and re-issue, e.g. HTTP 503 +
+        Retry-After) and ``retryable`` (no fatal rows at all)."""
+        CHECK(not self.is_sparse,
+              "scatter reads are for dense host-path tables")
+        rows = np.unique(np.ascontiguousarray(
+            row_ids, dtype=np.int32).reshape(-1))
+        self._check_row_ids(rows)
+        n = rows.size
+        out = np.empty((n, self.num_col), self.dtype)
+        owners = self._server_of_rows(rows)
+        # Generation AND shard latests are read BEFORE any fetch (the
+        # read_rows_versioned anchoring rule): values fetched across a
+        # concurrent reshard/rejoin get tagged with the OLD generation,
+        # so a derived cache storing them invalidates — tagging after
+        # the fetch could certify pre-move values as current.
+        generation = self.cache_generation()
+        latest_by_sid = {int(s): self._version_tracker.latest(int(s))
+                         for s in np.unique(owners)}
+        versions = np.full(n, -1, np.int64)
+        cached = np.zeros(n, bool)
+        cache = self._row_cache
+        missing = rows
+        if cache is not None:
+            missing = cache.fetch_into(rows, out)
+            if missing.size < n:
+                hit_pos = np.flatnonzero(~np.isin(rows, missing))
+                cached[hit_pos] = True
+                vmap = cache.versions_of(rows[hit_pos])
+                for p in hit_pos:
+                    # A row evicted between fetch_into and versions_of
+                    # reports the shard latest (staleness 0) — the
+                    # read_rows_versioned precedent.
+                    versions[p] = vmap.get(
+                        int(rows[p]), latest_by_sid[int(owners[p])])
+        failed_groups: List[np.ndarray] = []
+        fatal_groups: List[np.ndarray] = []
+        if missing.size:
+            entry = _ScatterRead(rows, out, versions)
+            group_sids = self._server_of_rows(missing)
+            groups = []
+            for sid in np.unique(group_sids):
+                grp = np.ascontiguousarray(missing[group_sids == sid])
+                msg_id = self._new_request()
+                self._sg[msg_id] = entry
+                groups.append((msg_id, grp))
+            for msg_id, grp in groups:
+                self._send_request(MsgType.Request_Get,
+                                   [Blob(grp.view(np.uint8))], msg_id)
+            try:
+                for msg_id, grp in groups:
+                    try:
+                        self.wait(msg_id)
+                    except (PeerLostError, RpcTimeoutError):
+                        failed_groups.append(grp)
+                    except TableRequestError:
+                        # Non-retryable: kept SEPARATE from the
+                        # retryable groups so a caller can decide per
+                        # ROW — one fatal group must not turn another
+                        # group's transient failure into a hard error.
+                        failed_groups.append(grp)
+                        fatal_groups.append(grp)
+                    finally:
+                        self._sg.pop(msg_id, None)
+            finally:
+                # ClusterAborted mid-loop must not strand later
+                # entries (pop is idempotent).
+                for msg_id, _ in groups:
+                    self._sg.pop(msg_id, None)
+        failed = np.unique(np.concatenate(failed_groups)) \
+            .astype(np.int32) if failed_groups \
+            else np.empty(0, np.int32)
+        failed_fatal = np.unique(np.concatenate(fatal_groups)) \
+            .astype(np.int32) if fatal_groups \
+            else np.empty(0, np.int32)
+        return out, {
+            "rows": rows, "versions": versions, "owners": owners,
+            "cached": cached, "latest_by_sid": latest_by_sid,
+            "failed": failed, "failed_fatal": failed_fatal,
+            "retryable": failed_fatal.size == 0,
+            "generation": generation}
 
     # -- client-cache prefetch + in-flight Get dedup --
     def prefetch_rows_async(self, row_ids) -> int:
@@ -1240,6 +1375,15 @@ class MatrixWorker(WorkerTable):
                     self._version_tracker.note(owner, floor)
                     self._row_cache.store(gkeys, gvals, floor, owner)
             return
+        sg = self._sg.get(self._reply_msg_id) \
+            if self._reply_msg_id >= 0 else None
+        if sg is not None:
+            # Scatter-gather sub-request shard: values/versions land in
+            # the request's own buffers (never the shared _dest
+            # registers), replica groups and repairs handled exactly
+            # like the classic path.
+            self._process_sg_reply(sg, reply_blobs)
+            return
         if reply_blobs[0].on_device:
             # Device-key reply: values arrive shaped
             # row_ids.shape + (num_col,), still in HBM — keyed by the
@@ -1398,27 +1542,75 @@ class MatrixWorker(WorkerTable):
                                values: np.ndarray,
                                reply_blobs: List[Blob],
                                requested: Optional[np.ndarray]) -> None:
-        """A holder shard's reply: owned rows attribute to the holder
-        as usual; each replica group attributes to its OWNER at the
-        group's version floor. Groups below this worker's read-your-
-        writes floor are discarded (their values may predate an Add the
-        owner already acked to us) and — together with routed rows the
-        holder did not serve at all — REPAIR to their owners under the
-        same request id (the worker actor transfers this reply's notify
-        onto the repairs, so wait() completes only when they landed)."""
-        groups = self._replica_groups(keys, values, reply_blobs)
+        """A holder shard's reply on the CLASSIC (one-get-in-flight)
+        path: placement targets the shared destination registers."""
+
+        def place(gkeys, gvals, version, owner):
+            if self._row_cache is not None \
+                    and self._dest_rows is not None:
+                self._row_cache.store(gkeys, gvals, version, owner)
+            if self._dest is not None and self._dest_rows is not None:
+                client_cache.place_rows(gkeys, gvals, self._dest_rows,
+                                        self._dest)
+
+        self._serve_reply_groups(keys, values, reply_blobs, requested,
+                                 place)
+
+    def _process_sg_reply(self, entry: _ScatterRead,
+                          reply_blobs: List[Blob]) -> None:
+        """A scatter-gather sub-request's reply shard: same semantics
+        as the classic path (cache population, replica-group floors,
+        repair staging under the same request id), but placement goes
+        to the sub-request's OWN buffers."""
+        keys = reply_blobs[0].as_array(np.int32)
+        values = reply_blobs[1].as_array(self.dtype).reshape(
+            keys.size, self.num_col)
+        requested = None
+        ent = self._replica_sent.get(self._reply_msg_id)
+        if ent is not None:
+            requested = ent.pop(self._reply_server, None)
+            if not ent:
+                del self._replica_sent[self._reply_msg_id]
+
+        def place(gkeys, gvals, version, owner):
+            if self._row_cache is not None:
+                self._row_cache.store(gkeys, gvals, version, owner)
+            if gkeys.size == 0:
+                return
+            pos = np.minimum(np.searchsorted(entry.rows, gkeys),
+                             entry.rows.size - 1)
+            ok = entry.rows[pos] == gkeys  # repairs may widen to rows
+            pos = pos[ok]                  # outside this entry's set
+            entry.out[pos] = gvals[ok]
+            if version >= 0:
+                entry.versions[pos] = np.maximum(entry.versions[pos],
+                                                 int(version))
+
+        self._serve_reply_groups(keys, values, reply_blobs, requested,
+                                 place)
+
+    def _serve_reply_groups(self, keys: np.ndarray, values: np.ndarray,
+                            reply_blobs: List[Blob],
+                            requested: Optional[np.ndarray],
+                            place) -> None:
+        """Shared reply-shard semantics for the classic and scatter
+        read paths: owned rows attribute to the replying shard at the
+        header version; each replica group attributes to its OWNER at
+        the group's version floor. Groups below this worker's read-
+        your-writes floor are discarded (their values may predate an
+        Add the owner already acked to us) and — together with routed
+        rows the holder did not serve at all — REPAIR to their owners
+        under the same request id (the worker actor transfers this
+        reply's notify onto the repairs, so wait() completes only when
+        they landed). ``place(keys, values, version, owner)`` is the
+        path-specific sink (cache store + destination placement)."""
         n_own = keys.size - self._reply_replica_rows
-        own_keys, own_vals = keys[:n_own], values[:n_own]
-        if self._row_cache is not None and self._dest_rows is not None:
-            self._row_cache.store(own_keys, own_vals,
-                                  self._reply_version,
-                                  self._reply_server)
-        if self._dest is not None and self._dest_rows is not None:
-            client_cache.place_rows(own_keys, own_vals,
-                                    self._dest_rows, self._dest)
+        place(keys[:n_own], values[:n_own], self._reply_version,
+              self._reply_server)
         served: List[np.ndarray] = []
         stale: List[np.ndarray] = []
-        for owner, floor, gkeys, gvals in groups:
+        for owner, floor, gkeys, gvals in \
+                self._replica_groups(keys, values, reply_blobs):
             if floor < self.add_floor(owner):
                 count_event(replica_mod.REPLICA_STALE, int(gkeys.size))
                 stale.append(gkeys)
@@ -1429,11 +1621,7 @@ class MatrixWorker(WorkerTable):
             # not the generation-change regression signal that
             # invalidates caches.
             self._version_tracker.note(owner, floor)
-            if self._row_cache is not None and self._dest_rows is not None:
-                self._row_cache.store(gkeys, gvals, floor, owner)
-            if self._dest is not None and self._dest_rows is not None:
-                client_cache.place_rows(gkeys, gvals, self._dest_rows,
-                                        self._dest)
+            place(gkeys, gvals, floor, owner)
         repair = list(stale)
         if requested is not None:
             got = np.concatenate(served + stale) if (served or stale) \

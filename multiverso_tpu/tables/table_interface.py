@@ -81,6 +81,20 @@ class WorkerTable:
         #: serving shard changes GENERATION (server restart + snapshot
         #: restore resets its version counter; docs/FAULT_TOLERANCE.md).
         self._caches: List = []
+        #: Data-generation counter for DERIVED read-side caches (the
+        #: serving tier's neighbors index and hot-response cache,
+        #: docs/SERVING.md): bumped on every event that makes version
+        #: arithmetic against the old shard counters meaningless — a
+        #: server-generation regression (PR-6 rejoin) and a shard-map
+        #: epoch change (PR-12 elastic resharding). Version staleness
+        #: alone misses both: a restored/remapped shard's counter can
+        #: sit BELOW a derived cache's anchor version forever, so
+        #: ``latest - anchor <= bound`` would hold while the underlying
+        #: rows changed arbitrarily. Derived caches record the value at
+        #: build/store time and treat any mismatch as forced
+        #: invalidation. Written on the worker actor thread, read from
+        #: serving threads — int assignment, GIL-atomic.
+        self._data_generation = 0
         self._on_complete: Dict[int, List[Callable]] = {}
         self._reply_server = -1
         self._reply_version = -1
@@ -413,6 +427,7 @@ class WorkerTable:
                       self.table_id, server_id,
                       self._version_tracker.latest(server_id), version)
             self._version_tracker.reset(server_id, version)
+            self._data_generation += 1
             for cache in self._caches:
                 cache.invalidate_server(server_id)
         self._version_tracker.note(server_id, version)
@@ -494,8 +509,15 @@ class WorkerTable:
                  "epoch change) — treating as a generation change, "
                  "invalidating client caches for that shard",
                  self.table_id, old_sid)
+        self._data_generation += 1
         for cache in self._caches:
             cache.invalidate_server(old_sid)
+
+    def cache_generation(self) -> int:
+        """Current data generation (see ``_data_generation``): derived
+        read-side caches compare this against the value they recorded
+        at build time and rebuild on any difference."""
+        return self._data_generation
 
     # -- hot-shard replication plumbing (runtime/replica.py) --
     def apply_replica_map(self, epoch: int, rows) -> None:

@@ -107,6 +107,13 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "serving_shed_depth": 256,
     "serving_retry_after_s": 0.05,
     "serving_drain_s": 5.0,
+    "serving_scatter": True,
+    "serving_batch_window_ms": 2.0,
+    "serving_batch_max_rows": 1024,
+    "serving_hot_rows": 4096,
+    "serving_fleet_interval_s": 2.0,
+    "ann_nlist": 0,
+    "ann_nprobe": 8,
     # -- wordembedding model (models/wordembedding/) --
     "train_file": "",
     "output_file": "vectors.txt",

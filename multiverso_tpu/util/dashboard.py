@@ -85,6 +85,10 @@ METRIC_NAMES: Dict[str, str] = {
     "SERVING_REQUESTS": "serving-frontend requests admitted and served",
     "SERVING_SHED": "serving-frontend requests rejected by admission",
     "SERVING_LATENCY_MS": "serving-frontend request latency (ms)",
+    "SERVING_BATCH_SIZE": "requests folded into one serving read batch",
+    "SERVING_CACHE_HIT": "requests served whole from the hot-response "
+                         "cache",
+    "ANN_PROBE_MS": "IVF neighbors probe latency (ms)",
 }
 
 #: Version stamp on serialized metrics snapshots

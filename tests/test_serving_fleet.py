@@ -1,0 +1,793 @@
+"""Serving fleet (docs/SERVING.md fleet section): scatter-gather
+reads with row-scoped partial-failure containment, request batching
+boundaries, the hot-response cache's freshness + forced-invalidation
+rules, the IVF neighbors index, the /v1/status fleet view, and the
+reshard-mid-serving no-stale-results regression."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.runtime.cluster import LocalCluster
+from multiverso_tpu.serving.ann import IVFIndex
+from multiverso_tpu.serving.batch import (BatchedTableReader,
+                                          HotRowCache,
+                                          UpstreamReadError,
+                                          request_meta)
+from multiverso_tpu.serving.frontend import ServingFrontend
+from multiverso_tpu.util.configure import set_flag
+from multiverso_tpu.util.dashboard import samples
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _http_error(url, timeout=15):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(url, timeout=timeout)
+    err = exc.value
+    body = json.loads(err.read())
+    return err.code, dict(err.headers), body
+
+
+# ---------------------------------------------------------------------------
+# scatter-gather read path (tables/matrix_table.py read_rows_scatter)
+# ---------------------------------------------------------------------------
+
+class TestScatterRead:
+    def test_values_versions_and_cache_flags(self):
+        mv.init([])
+        set_flag("max_get_staleness", 8)
+        try:
+            table = mv.create_matrix_table(64, 4)
+            expected = np.arange(64 * 4, dtype=np.float32) \
+                .reshape(64, 4)
+            table.add_rows(np.arange(64, dtype=np.int32), expected)
+            values, info = table.read_rows_scatter(
+                np.asarray([3, 5, 3, 60], np.int32))
+            assert (info["rows"] == [3, 5, 60]).all()
+            np.testing.assert_allclose(values, expected[[3, 5, 60]])
+            assert info["failed"].size == 0 and info["retryable"]
+            assert (info["versions"] >= 0).all()
+            assert not info["cached"].any()  # first read fetched
+            values2, info2 = table.read_rows_scatter(
+                np.asarray([3, 5, 60], np.int32))
+            np.testing.assert_allclose(values2, expected[[3, 5, 60]])
+            assert info2["cached"].all()
+        finally:
+            set_flag("max_get_staleness", 0)
+            mv.shutdown()
+
+    def test_cache_disabled_still_serves(self):
+        mv.init([])  # default flags: no client cache
+        try:
+            table = mv.create_matrix_table(32, 4)
+            expected = np.ones((32, 4), np.float32)
+            table.add_rows(np.arange(32, dtype=np.int32), expected)
+            values, info = table.read_rows_scatter(
+                np.asarray([1, 2], np.int32))
+            np.testing.assert_allclose(values, expected[[1, 2]])
+            assert not info["cached"].any()
+            assert info["failed"].size == 0
+        finally:
+            mv.shutdown()
+
+    def test_concurrent_reads_stay_exact_under_a_trainer(self):
+        """Any number of scatter reads may be in flight concurrently
+        (no shared destination registers) while a trainer Adds; the
+        per-row staleness invariant holds on every result."""
+        mv.init([])
+        set_flag("max_get_staleness", 8)
+        try:
+            table = mv.create_matrix_table(64, 4)
+            expected = np.arange(64 * 4, dtype=np.float32) \
+                .reshape(64, 4)
+            table.add_rows(np.arange(64, dtype=np.int32), expected)
+            stop = threading.Event()
+            errors = []
+
+            def trainer():
+                while not stop.is_set():
+                    table.add_rows(np.asarray([1], np.int32),
+                                   np.ones((1, 4), np.float32))
+
+            def reader(seed):
+                rng = np.random.default_rng(seed)
+                try:
+                    for _ in range(100):
+                        req = rng.integers(0, 64, 5).astype(np.int32)
+                        values, info = table.read_rows_scatter(req)
+                        assert info["failed"].size == 0
+                        for p, row in enumerate(info["rows"]):
+                            if row != 1:  # the trainer's moving row
+                                np.testing.assert_allclose(
+                                    values[p], expected[row])
+                            version = int(info["versions"][p])
+                            owner = int(info["owners"][p])
+                            if version >= 0:
+                                assert info["latest_by_sid"][owner] \
+                                    - version <= 8
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            t = threading.Thread(target=trainer)
+            readers = [threading.Thread(target=reader, args=(i,))
+                       for i in range(4)]
+            t.start()
+            for r in readers:
+                r.start()
+            for r in readers:
+                r.join()
+            stop.set()
+            t.join()
+            assert not errors, errors
+        finally:
+            set_flag("max_get_staleness", 0)
+            mv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scatter-gather partial failure: dead/silent shard owner
+# ---------------------------------------------------------------------------
+
+def _drop_gets_toward(zoo, dead_rank, table_id):
+    """Monkeypatch the rank's communicator to swallow Request_Get
+    shards toward ``dead_rank`` for ``table_id`` — the observable
+    shape of a dead/unreachable shard owner (with -rpc_timeout_s the
+    sub-request fails typed-retryably instead of blocking). Returns
+    an undo callable."""
+    from multiverso_tpu.core.message import MsgType
+    comm = zoo._actors["communicator"]
+    original = comm.receive
+
+    def dropping(msg):
+        if (msg.type == MsgType.Request_Get and msg.dst == dead_rank
+                and msg.table_id == table_id):
+            return  # vanishes: the owner never sees it
+        original(msg)
+
+    comm.receive = dropping
+
+    def undo():
+        comm.receive = original
+
+    return undo
+
+
+class TestScatterPartialFailure:
+    def test_dead_owner_fails_only_its_rows(self):
+        """2-server cluster, one owner silenced: the silenced shard's
+        rows fail retryably; every other row serves EXACTLY — never a
+        wrong value — and a follow-up read after heal succeeds."""
+        def body(rank):
+            table = mv.create_matrix_table(24, 3)
+            if table is None:
+                mv.current_zoo().barrier()
+                return None
+            expected = np.arange(24 * 3, dtype=np.float32) \
+                .reshape(24, 3)
+            table.add_rows(np.arange(24, dtype=np.int32), expected)
+            # sid0 owns rows 0-11 (this rank), sid1 owns 12-23
+            # (rank 1). Silence rank 1.
+            undo = _drop_gets_toward(mv.current_zoo(), 1,
+                                     table.table_id)
+            try:
+                values, info = table.read_rows_scatter(
+                    np.asarray([2, 5, 14, 20], np.int32))
+            finally:
+                undo()
+            out = {
+                "failed": sorted(int(r) for r in info["failed"]),
+                "retryable": bool(info["retryable"]),
+                "healthy_exact": bool(
+                    np.allclose(values[0], expected[2])
+                    and np.allclose(values[1], expected[5]))}
+            # Heal: the same read now serves everything.
+            values2, info2 = table.read_rows_scatter(
+                np.asarray([2, 5, 14, 20], np.int32))
+            out["healed"] = bool(
+                info2["failed"].size == 0
+                and np.allclose(values2, expected[[2, 5, 14, 20]]))
+            mv.current_zoo().barrier()
+            return out
+
+        cluster = LocalCluster(2, argv=["-rpc_timeout_s=0.8"],
+                               roles=["all", "server"])
+        result = cluster.run(body)[0]
+        assert result["failed"] == [14, 20]
+        assert result["retryable"] is True
+        assert result["healthy_exact"] is True
+        assert result["healed"] is True
+
+    def test_frontend_maps_partial_failure_to_503_on_affected_rows(
+            self):
+        """HTTP shape of the same failure: requests touching the dead
+        owner's rows answer 503 + Retry-After naming failed_rows;
+        requests on healthy shards answer 200 with exact values."""
+        def body(rank):
+            table = mv.create_matrix_table(24, 3)
+            if table is None:
+                mv.current_zoo().barrier()
+                return None
+            expected = np.arange(24 * 3, dtype=np.float32) \
+                .reshape(24, 3)
+            table.add_rows(np.arange(24, dtype=np.int32), expected)
+            frontend = ServingFrontend(mv.current_zoo(), port=0,
+                                       host="127.0.0.1")
+            frontend.register_table("emb", table)
+            base = f"http://127.0.0.1:{frontend.port}"
+            out = {}
+            undo = _drop_gets_toward(mv.current_zoo(), 1,
+                                     table.table_id)
+            try:
+                status, _, doc = _get(base
+                                      + "/v1/tables/emb/rows?ids=2,5")
+                out["healthy_status"] = status
+                out["healthy_exact"] = bool(np.allclose(
+                    np.asarray(doc["rows"]), expected[[2, 5]]))
+                code, headers, body_doc = _http_error(
+                    base + "/v1/tables/emb/rows?ids=5,14")
+                out["failed_status"] = code
+                out["retry_after"] = headers.get("Retry-After")
+                out["failed_rows"] = body_doc.get("failed_rows")
+                out["retryable"] = body_doc.get("retryable")
+            finally:
+                undo()
+                frontend.stop()
+            mv.current_zoo().barrier()
+            return out
+
+        cluster = LocalCluster(2, argv=["-rpc_timeout_s=0.8"],
+                               roles=["all", "server"])
+        result = cluster.run(body)[0]
+        assert result["healthy_status"] == 200
+        assert result["healthy_exact"] is True
+        assert result["failed_status"] == 503
+        assert result["retry_after"] is not None
+        assert result["failed_rows"] == [14]
+        assert result["retryable"] is True
+
+
+# ---------------------------------------------------------------------------
+# request batching (serving/batch.py BatchedTableReader)
+# ---------------------------------------------------------------------------
+
+class _FakeScatterTable:
+    """Duck-typed stand-in for MatrixWorker on the scatter contract:
+    deterministic values, per-call recording, optional latency and
+    scripted row failures."""
+
+    def __init__(self, num_row=64, num_col=3, delay_s=0.0,
+                 fail_rows=(), fatal_rows=()):
+        self.num_row = num_row
+        self.num_col = num_col
+        self.delay_s = delay_s
+        self.fail_rows = set(int(r) for r in fail_rows) \
+            | set(int(r) for r in fatal_rows)
+        self.fatal_rows = set(int(r) for r in fatal_rows)
+        self.calls = []
+        self.generation = 0
+        self.latest = 5
+
+    def value_of(self, row):
+        return np.full(self.num_col, float(row), np.float32)
+
+    def read_rows_scatter(self, row_ids):
+        rows = np.unique(np.asarray(row_ids, np.int32))
+        self.calls.append(rows)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        values = np.stack([self.value_of(int(r)) for r in rows])
+        failed = np.asarray(sorted(self.fail_rows
+                                   & set(int(r) for r in rows)),
+                            np.int32)
+        fatal = np.asarray(sorted(self.fatal_rows
+                                  & set(int(r) for r in rows)),
+                           np.int32)
+        return values, {
+            "rows": rows,
+            "versions": np.full(rows.size, self.latest, np.int64),
+            "owners": np.zeros(rows.size, np.int64),
+            "cached": np.zeros(rows.size, bool),
+            "latest_by_sid": {0: self.latest},
+            "failed": failed, "failed_fatal": fatal,
+            "retryable": fatal.size == 0,
+            "generation": self.generation}
+
+    # HotRowCache probes
+    def cache_generation(self):
+        return self.generation
+
+    def observed_versions(self):
+        return {0: self.latest}
+
+
+class TestBatching:
+    def _reader(self, table, window_ms, max_rows=1024):
+        return BatchedTableReader("t", table, lambda: 8,
+                                  window_ms=window_ms,
+                                  max_rows=max_rows)
+
+    def test_lone_request_flushes_on_the_window_deadline(self):
+        table = _FakeScatterTable()
+        reader = self._reader(table, window_ms=40.0)
+        try:
+            t0 = time.perf_counter()
+            values, meta, _ = reader.read(np.asarray([7, 3, 7]))
+            elapsed = time.perf_counter() - t0
+            # Never longer than the window plus scheduling slack —
+            # the lone-request latency bound IS the window.
+            assert elapsed < 1.0
+            np.testing.assert_allclose(
+                values, np.stack([table.value_of(7),
+                                  table.value_of(3),
+                                  table.value_of(7)]))
+            assert meta["rows_requested"] == 2
+            assert reader.batches == 1
+        finally:
+            reader.stop()
+
+    def test_concurrent_requests_fold_into_one_scatter_call(self):
+        table = _FakeScatterTable()
+        reader = self._reader(table, window_ms=80.0)
+        results, errors = {}, []
+
+        def client(i):
+            try:
+                ids = np.asarray([i, i + 10])
+                values, meta, _ = reader.read(ids)
+                results[i] = values
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            for i in range(8):
+                np.testing.assert_allclose(
+                    results[i],
+                    np.stack([table.value_of(i),
+                              table.value_of(i + 10)]))
+            # 8 concurrent requests inside one 80 ms window: folded
+            # into far fewer merged reads (usually exactly 1; the
+            # first may slip into its own batch under scheduling).
+            assert reader.batches <= 2
+            assert reader.requests == 8
+            assert len(table.calls) == reader.batches
+            assert samples("SERVING_BATCH_SIZE").count > 0
+        finally:
+            reader.stop()
+
+    def test_size_cap_flushes_before_the_window(self):
+        table = _FakeScatterTable()
+        # A 10-SECOND window: only the size cap can flush this batch
+        # quickly. 4 requests x 4 unique rows reach the 16-row cap.
+        reader = self._reader(table, window_ms=10_000.0, max_rows=16)
+        done = []
+
+        def client(i):
+            ids = np.arange(i * 4, i * 4 + 4)
+            reader.read(ids)
+            done.append(i)
+
+        try:
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert time.perf_counter() - t0 < 5.0  # not the window
+            assert len(done) == 4
+        finally:
+            reader.stop()
+
+    def test_batch_error_isolation(self):
+        """One request's failed rows fail THAT response; batch
+        siblings (and non-failed rows generally) are unaffected."""
+        table = _FakeScatterTable(fail_rows={5})
+        reader = self._reader(table, window_ms=60.0)
+        outcome = {}
+
+        def good():
+            values, meta, _ = reader.read(np.asarray([1, 2]))
+            outcome["good"] = values
+
+        def bad():
+            try:
+                reader.read(np.asarray([5, 6]))
+                outcome["bad"] = "no error"
+            except UpstreamReadError as exc:
+                outcome["bad"] = (exc.rows, exc.retryable)
+
+        try:
+            threads = [threading.Thread(target=good),
+                       threading.Thread(target=bad)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            np.testing.assert_allclose(
+                outcome["good"], np.stack([table.value_of(1),
+                                           table.value_of(2)]))
+            assert outcome["bad"] == ([5], True)
+        finally:
+            reader.stop()
+
+    def test_retryability_is_per_member_not_per_batch(self):
+        """A fatal failure in one batch member must not demote a
+        SIBLING member's transient (retryable) failure to a hard
+        error — retryability follows each request's own rows."""
+        table = _FakeScatterTable(fail_rows={5}, fatal_rows={20})
+        reader = self._reader(table, window_ms=60.0)
+        outcome = {}
+
+        def transient():
+            try:
+                reader.read(np.asarray([5, 6]))
+            except UpstreamReadError as exc:
+                outcome["transient"] = (exc.rows, exc.retryable)
+
+        def fatal():
+            try:
+                reader.read(np.asarray([20, 21]))
+            except UpstreamReadError as exc:
+                outcome["fatal"] = (exc.rows, exc.retryable)
+
+        try:
+            threads = [threading.Thread(target=transient),
+                       threading.Thread(target=fatal)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert outcome["transient"] == ([5], True)
+            assert outcome["fatal"] == ([20], False)
+        finally:
+            reader.stop()
+
+    def test_window_zero_serves_inline(self):
+        table = _FakeScatterTable()
+        reader = self._reader(table, window_ms=0.0)
+        values, meta, _ = reader.read(np.asarray([4]))
+        np.testing.assert_allclose(values, [table.value_of(4)])
+        assert reader._thread is None  # no batcher thread at all
+        reader.stop()
+
+    def test_request_meta_staleness_fields(self):
+        info = {"versions": np.asarray([3, -1, 7], np.int64),
+                "owners": np.asarray([0, 0, 1], np.int64),
+                "cached": np.asarray([True, False, False]),
+                "latest_by_sid": {0: 9, 1: 7}}
+        meta = request_meta(info, np.arange(3), bound=8)
+        assert meta["served_version"] == 3  # the -1 reads as latest
+        assert meta["latest_version"] == 9
+        assert meta["max_staleness"] == 6  # 9 - 3
+        assert meta["cache_hit"] is False
+        assert meta["rows_requested"] == 3
+        assert meta["rows_cached"] == 1
+
+
+# ---------------------------------------------------------------------------
+# hot-response cache (serving/batch.py HotRowCache)
+# ---------------------------------------------------------------------------
+
+def _detail_for(table, rows):
+    rows = np.asarray(rows, np.int32)
+    return {"rows": rows,
+            "values": np.stack([table.value_of(int(r))
+                                for r in rows]),
+            "versions": np.full(rows.size, table.latest, np.int64),
+            "owners": np.zeros(rows.size, np.int64),
+            "generation": table.generation}
+
+
+class TestHotRowCache:
+    def test_store_lookup_roundtrip_with_duplicates(self):
+        table = _FakeScatterTable()
+        cache = HotRowCache(table, lambda: 8, capacity=16)
+        assert cache.lookup(np.asarray([3, 5])) is None  # cold
+        cache.store(_detail_for(table, [3, 5]))
+        served = cache.lookup(np.asarray([5, 3, 5]))
+        assert served is not None
+        rendered, meta = served
+        np.testing.assert_allclose(
+            np.asarray(rendered),
+            np.stack([table.value_of(5), table.value_of(3),
+                      table.value_of(5)]))
+        assert meta["cache_hit"] is True
+        assert meta["rows_requested"] == 2
+        assert meta["max_staleness"] == 0
+        # Partial coverage is a miss (all-or-nothing).
+        assert cache.lookup(np.asarray([3, 9])) is None
+
+    def test_staleness_bound_invalidates(self):
+        table = _FakeScatterTable()
+        cache = HotRowCache(table, lambda: 4, capacity=16)
+        cache.store(_detail_for(table, [3]))
+        assert cache.lookup(np.asarray([3])) is not None
+        table.latest += 4  # aged exactly to the bound: still serves
+        assert cache.lookup(np.asarray([3])) is not None
+        table.latest += 1  # past it
+        assert cache.lookup(np.asarray([3])) is None
+
+    def test_generation_change_forces_invalidation(self):
+        """A reshard/rejoin (generation bump) invalidates even though
+        version arithmetic says fresh — the satellite-1 rule."""
+        table = _FakeScatterTable()
+        cache = HotRowCache(table, lambda: 8, capacity=16)
+        cache.store(_detail_for(table, [3]))
+        assert cache.lookup(np.asarray([3])) is not None
+        table.generation += 1  # versions untouched
+        assert cache.lookup(np.asarray([3])) is None
+
+    def test_capacity_eviction(self):
+        table = _FakeScatterTable()
+        cache = HotRowCache(table, lambda: 8, capacity=4)
+        cache.store(_detail_for(table, [0, 1, 2, 3, 4, 5]))
+        assert cache.stats["rows"] == 4
+
+    def test_lru_promotion_keeps_the_hot_head(self):
+        """A row served from the cache (never re-stored) must not
+        stay oldest in the eviction order: hits promote, so capacity
+        overflow evicts the coldest row, not the hottest."""
+        table = _FakeScatterTable()
+        cache = HotRowCache(table, lambda: 8, capacity=3)
+        cache.store(_detail_for(table, [0, 1, 2]))
+        assert cache.lookup(np.asarray([0])) is not None  # promote 0
+        cache.store(_detail_for(table, [3]))  # overflow: evict...
+        assert cache.lookup(np.asarray([0])) is not None  # ...not 0
+        assert cache.lookup(np.asarray([1])) is None  # the coldest
+
+
+# ---------------------------------------------------------------------------
+# the data-generation counter (tables/table_interface.py)
+# ---------------------------------------------------------------------------
+
+class TestDataGeneration:
+    def test_regression_and_shard_move_both_bump(self):
+        mv.init([])
+        try:
+            table = mv.create_matrix_table(16, 2)
+            table.add_rows(np.asarray([0], np.int32),
+                           np.ones((1, 2), np.float32))
+            gen0 = table.cache_generation()
+            table.note_version(0, 100)
+            assert table.cache_generation() == gen0  # growth: no bump
+            table.note_version(0, 50)  # REGRESSION: server rejoin
+            assert table.cache_generation() == gen0 + 1
+            table.note_shard_moved(0)  # reshard epoch change
+            assert table.cache_generation() == gen0 + 2
+        finally:
+            mv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# IVF neighbors index (serving/ann.py)
+# ---------------------------------------------------------------------------
+
+def _clustered(n, dim, n_clusters, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1)[:, None]
+    assign = rng.integers(0, n_clusters, n)
+    values = centers[assign] \
+        + 0.05 * rng.standard_normal((n, dim)).astype(np.float32)
+    return values.astype(np.float32)
+
+
+def _brute_topk(values, norms, row, k):
+    q = values[row]
+    scores = (values @ q) / (norms * max(np.linalg.norm(q), 1e-12))
+    scores[row] = -np.inf
+    top = np.argpartition(-scores, k)[:k]
+    return top[np.argsort(-scores[top])]
+
+
+class TestIVFIndex:
+    def test_full_probe_matches_brute_exactly(self):
+        values = _clustered(512, 16, 8, seed=3)
+        norms = np.maximum(np.linalg.norm(values, axis=1), 1e-12)
+        index = IVFIndex(values, norms, nlist=8)
+        for row in (0, 17, 400):
+            ids, scores, scanned = index.search(
+                values[row], 10, nprobe=8, exclude=row)
+            assert scanned == 511  # every row except the query
+            brute = _brute_topk(values.copy(), norms, row, 10)
+            assert list(ids) == list(brute)
+
+    def test_small_nprobe_high_recall_on_clustered_data(self):
+        values = _clustered(2048, 16, 32, seed=4)
+        norms = np.maximum(np.linalg.norm(values, axis=1), 1e-12)
+        index = IVFIndex(values, norms, nlist=32)
+        hits = total = 0
+        for row in range(0, 200, 10):
+            ids, _, scanned = index.search(values[row], 10, nprobe=4,
+                                           exclude=row)
+            assert scanned < 2048 / 2  # really pruned
+            brute = set(int(i) for i in
+                        _brute_topk(values.copy(), norms, row, 10))
+            hits += len(brute & set(int(i) for i in ids))
+            total += 10
+        assert hits / total >= 0.95
+
+    def test_nlist_larger_than_table_clamps(self):
+        values = _clustered(10, 4, 2, seed=5)
+        norms = np.maximum(np.linalg.norm(values, axis=1), 1e-12)
+        index = IVFIndex(values, norms, nlist=64)
+        assert index.nlist == 10
+        ids, _, _ = index.search(values[0], 3, nprobe=10, exclude=0)
+        assert len(ids) == 3 and 0 not in ids
+
+    def test_nlist_clamps_to_the_kmeans_sample(self, monkeypatch):
+        """On a table bigger than the k-means training sample, nlist
+        must clamp to the SAMPLE (each centroid seeds on a distinct
+        training row), not just the table size."""
+        from multiverso_tpu.serving import ann as ann_mod
+        monkeypatch.setattr(ann_mod, "_KMEANS_SAMPLE", 32)
+        values = _clustered(100, 4, 4, seed=8)
+        norms = np.maximum(np.linalg.norm(values, axis=1), 1e-12)
+        index = IVFIndex(values, norms, nlist=64)  # 32 < 64 < 100
+        assert index.nlist == 32
+        ids, _, scanned = index.search(values[0], 5, nprobe=32,
+                                       exclude=0)
+        assert len(ids) == 5 and scanned == 99
+
+
+# ---------------------------------------------------------------------------
+# frontend integration: ANN endpoint, fleet status
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fleet_env():
+    mv.init([])
+    set_flag("max_get_staleness", 8)
+    set_flag("ann_nlist", 8)
+    set_flag("serving_fleet_interval_s", 0.1)
+    table = mv.create_matrix_table(256, 8)
+    values = _clustered(256, 8, 8, seed=6)
+    table.add_rows(np.arange(256, dtype=np.int32), values)
+    frontend = ServingFrontend(mv.current_zoo(), port=0,
+                               host="127.0.0.1")
+    frontend.register_table("emb", table)
+    yield frontend, table, f"http://127.0.0.1:{frontend.port}", values
+    frontend.stop()
+    set_flag("max_get_staleness", 0)
+    set_flag("ann_nlist", 0)
+    set_flag("serving_fleet_interval_s", 2.0)
+    mv.shutdown()
+
+
+class TestFrontendFleet:
+    def test_ivf_endpoint_and_brute_escape_agree(self, fleet_env):
+        frontend, table, base, values = fleet_env
+        _, _, ivf = _get(base + "/v1/tables/emb/neighbors"
+                              "?id=7&k=5&nprobe=8")
+        assert ivf["index"]["kind"] == "ivf"
+        assert ivf["index"]["nlist"] == 8
+        _, _, brute = _get(base + "/v1/tables/emb/neighbors"
+                                "?id=7&k=5&brute=1")
+        assert brute["index"]["kind"] == "brute"
+        # Full probe == exact: identical ranking.
+        assert [n["id"] for n in ivf["neighbors"]] \
+            == [n["id"] for n in brute["neighbors"]]
+        assert samples("ANN_PROBE_MS").count > 0
+
+    def test_status_carries_rank_and_fleet_aggregate(self, fleet_env):
+        frontend, table, base, values = fleet_env
+        deadline = time.monotonic() + 5.0
+        fleet = None
+        while time.monotonic() < deadline:
+            _, _, status = _get(base + "/v1/status")
+            fleet = status["fleet"]
+            if fleet is not None:
+                break
+            time.sleep(0.05)
+        assert status["rank"] == 0
+        assert fleet is not None, "fleet view never arrived"
+        assert fleet["aggregate"]["frontends"] == 1
+        assert "0" in fleet["frontends"]
+        assert fleet["aggregate"]["shed"] == 0
+
+    def test_hot_cache_marks_response_and_skips_table(self, fleet_env):
+        frontend, table, base, values = fleet_env
+        url = base + "/v1/tables/emb/rows?ids=11,13"
+        _, _, first = _get(url)
+        assert first["response_cache"] == "miss"
+        _, headers, second = _get(url)
+        assert second["response_cache"] == "hit"
+        assert second["cache_hit"] is True
+        assert headers["X-MV-Cache"] == "hit"
+        np.testing.assert_allclose(np.asarray(second["rows"]),
+                                   np.asarray(first["rows"]))
+        entry = frontend._entry("emb")
+        assert entry.hot.stats["hits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the satellite-1 regression: reshard mid-serving must not serve
+# stale neighbors or stale hot-cache rows
+# ---------------------------------------------------------------------------
+
+class TestReshardMidServing:
+    def test_no_stale_results_after_reshard(self):
+        def body(rank):
+            table = mv.create_matrix_table(24, 4)
+            if table is None:
+                mv.current_zoo().barrier()
+                return None
+            # Rows 20/21 are the probes: pre-reshard row 20 is
+            # parallel to the query row 0, row 21 orthogonal.
+            base = np.zeros((24, 4), np.float32)
+            base[:, 2] = 1.0
+            base[0] = [1, 0, 0, 0]
+            base[20] = [0.9, 0.1, 0, 0]
+            base[21] = [0, 0, 0, 1]
+            table.add_rows(np.arange(24, dtype=np.int32), base)
+            frontend = ServingFrontend(mv.current_zoo(), port=0,
+                                       host="127.0.0.1")
+            frontend.register_table("emb", table)
+            api = f"http://127.0.0.1:{frontend.port}/v1/tables/emb"
+            out = {}
+            try:
+                _, _, pre = _get(api + "/neighbors?id=0&k=1")
+                out["pre_top"] = pre["neighbors"][0]["id"]
+                _, _, row_pre = _get(api + "/rows?ids=20")
+                _, _, row_pre2 = _get(api + "/rows?ids=20")
+                out["hot_warm"] = row_pre2["response_cache"]
+                # Grow the fleet: rows 16-23 (incl. both probes) move
+                # to the standby server 2, whose shard version counter
+                # starts BELOW the index/cache anchors — version
+                # staleness alone would claim everything fresh.
+                mv.reshard_table(table, [0, 1, 2], wait_s=60.0)
+                # Flip the probes: row 20 -> orthogonal, row 21 ->
+                # parallel. Few adds, far inside the staleness bound.
+                table.add_rows(
+                    np.asarray([20, 21], np.int32),
+                    np.asarray([[-0.9, -0.1, 0, 1],
+                                [1, 0, 0, -1]], np.float32))
+                _, _, post = _get(api + "/neighbors?id=0&k=1")
+                out["post_top"] = post["neighbors"][0]["id"]
+                out["post_refreshed"] = post["index_refreshed"]
+                _, _, row_post = _get(api + "/rows?ids=20")
+                out["row_current"] = bool(np.allclose(
+                    np.asarray(row_post["rows"][0]),
+                    [0.0, 0.0, 0.0, 1.0], atol=1e-5))
+                out["row_stale_copy"] = row_post["rows"][0] \
+                    == row_pre["rows"][0]
+            finally:
+                frontend.stop()
+            mv.current_zoo().barrier()
+            return out
+
+        cluster = LocalCluster(3, argv=["-shard_initial_servers=2",
+                                        "-max_get_staleness=8"],
+                               roles=["all", "server", "server"])
+        cluster.timeout = 240.0
+        try:
+            result = cluster.run(body)[0]
+        finally:
+            set_flag("max_get_staleness", 0)
+            set_flag("shard_initial_servers", 0)
+        assert result["pre_top"] == 20
+        assert result["hot_warm"] == "hit"  # the cache WAS live
+        assert result["post_top"] == 21, \
+            "stale neighbors index served after reshard"
+        assert result["post_refreshed"] is True
+        assert result["row_current"] is True, \
+            "stale hot-cache row served after reshard"
+        assert result["row_stale_copy"] is False
