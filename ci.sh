@@ -86,6 +86,19 @@ if [ "${MV_CI_SLOW:-0}" = "1" ]; then
     python -m pytest tests/test_resharding.py -x -q -m slow
 fi
 
+echo "== autotune subset (dynamic flags / config broadcast / policies) =="
+# The closed-loop self-tuning layer gets its own named gate: the
+# TUNABLE_FLAGS dynamic-flag layer (apply hooks fire on broadcast,
+# non-tunable flags rejected atomically, config-epoch regression
+# ignored, weak hooks pruned), the Control_Config/Reply round trip,
+# the rejoin config re-anchor, the AutotuneManager policies
+# (SLO-gated widening/shrinking, hysteresis, cooldown, pinning,
+# guardrails), live retunes of construction-time caches, and the
+# ClusterMetrics ingest ordering guard (tests/test_autotune.py;
+# docs/AUTOTUNE.md). The static half of the gate — tunable-lint —
+# already ran in the mvlint block above.
+python -m pytest tests/test_autotune.py -x -q -m 'not slow'
+
 echo "== obs subset (tracing / metrics export / scrape surface) =="
 # Observability invariants get their own named gate: trace-id sampling
 # and wire propagation (TRACE_SLOT, byte-identity when off), the span

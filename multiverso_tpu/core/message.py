@@ -118,6 +118,22 @@ class MsgType(enum.IntEnum):
     # mvlint pass 6).
     Control_Serving_Report = 42
     Control_Reply_Serving = -42
+    # Closed-loop self-tuning control plane (runtime/autotune.py,
+    # docs/AUTOTUNE.md): the controller's AutotuneManager broadcasts
+    # epoch-stamped live-config updates (JSON blob
+    # {"epoch": N, "flags": {...}}, every flag declared in
+    # util/configure.py TUNABLE_FLAGS) to every rank — below the
+    # worker band and intercepted BY NAME in the communicator's
+    # routing like Control_Shard_Map (it must not fall through to the
+    # Zoo mailbox where a blocked barrier would consume it). The
+    # receiving rank acks with Control_Reply_Config (int64
+    # [rank, applied_epoch, applied]; the type negation of the
+    # broadcast, riding the controller band) so the controller's
+    # gauges can show per-rank config convergence. Both directions
+    # ride net.send_async (the liveness-frame discipline —
+    # mvlint pass 6).
+    Control_Reply_Config = 43
+    Control_Config = -43
 
 HEADER_SIZE = 10  # ints (8 in the reference; slot 8 added for
 #                   replication, slot 9 for request tracing)

@@ -69,7 +69,8 @@ import numpy as np
 from ..core.blob import Blob
 from ..core.message import Message, MsgType, is_wire_encoded
 from ..util.configure import (define_bool, define_double, define_int,
-                              define_string, get_flag)
+                              define_string, get_flag,
+                              register_tunable_hook)
 from ..util.dashboard import samples
 from ..util.wire_codec import (CODEC_SLOT, break_even_density, decode_blob,
                                decode_blob_sparse, density_of, encode_blob,
@@ -126,6 +127,21 @@ define_int("allreduce_sparse_idx_budget", 8388608,
            "(density x elements) the sparse path will carry per "
            "collective — past it the per-index Python merge cost beats "
            "the dense ring's streaming chunks even at low density")
+
+
+def _chunk_kb_retuned(value) -> None:
+    """``-allreduce_chunk_kb`` is read fresh per collective call
+    (``_chunk_elems``), so a live retune needs no state rebind — this
+    hook declares the handoff (the ``TUNABLE_FLAGS`` contract: every
+    tunable names HOW its value lands) and logs the step so the knob
+    trajectory is traceable in rank logs, not just controller
+    gauges."""
+    from ..util import log
+    log.info("allreduce: -allreduce_chunk_kb retuned to %s (applies "
+             "from the next collective call)", value)
+
+
+register_tunable_hook("allreduce_chunk_kb", _chunk_kb_retuned)
 
 _SMALL_BYTES = 4096  # allgather-based path threshold (ref: engine.cpp:33)
 

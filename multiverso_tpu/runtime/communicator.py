@@ -459,6 +459,16 @@ class Communicator(Actor):
                     copy.data = list(msg.data)
                     self._zoo.route(name, copy)
             return
+        if msg_type == int(MsgType.Control_Config):
+            # Epoch-stamped live-config broadcast (closed-loop
+            # autotune, docs/AUTOTUNE.md): applied HERE through the
+            # dynamic-flag layer — set_flag + per-flag apply hooks so
+            # construction-time caches re-knob — then acked back to
+            # the controller so its gauges show per-rank convergence.
+            # Like Control_Shard_Map it must not fall through to the
+            # Zoo mailbox.
+            self._apply_config(msg)
+            return
         if msg_type == int(MsgType.Control_Replica_Map):
             # Promoted-row map broadcast: both sides of this rank need
             # it — the worker's tables re-route their Gets, the
@@ -501,3 +511,50 @@ class Communicator(Actor):
             self._zoo.route(actors.CONTROLLER, msg)
         else:
             self._zoo.mailbox.push(msg)
+
+    def _apply_config(self, msg: Message) -> None:
+        """Apply one ``Control_Config`` broadcast through the dynamic-
+        flag layer (util/configure.py ``apply_config``: epoch
+        regression ignored, non-tunable flags rejected whole) and ack
+        the applied watermark back to the controller. Runs on the recv
+        thread — hooks must stay cheap (their contract)."""
+        import json
+        from ..util import configure
+        try:
+            doc = json.loads(bytes(
+                msg.data[0].as_array(np.uint8)).decode())
+            epoch = int(doc["epoch"])
+            flags = dict(doc["flags"])
+        except Exception:  # noqa: BLE001 - a malformed broadcast must
+            # not kill the recv thread; the controller's next broadcast
+            # supersedes it
+            log.error("rank %d: undecodable Control_Config broadcast",
+                      self._zoo.rank)
+            return
+        try:
+            applied = configure.apply_config(epoch, flags)
+        except Exception as exc:  # noqa: BLE001 - a refused broadcast
+            # (non-tunable flag, garbage value: controller bug or
+            # version skew) was rejected WHOLE and must not kill the
+            # recv thread — say so loudly, and ack the UNCHANGED
+            # watermark so the controller sees this rank not
+            # converging.
+            log.error("rank %d: Control_Config refused: %s",
+                      self._zoo.rank, exc)
+            applied = False
+        reply = msg.create_reply_message()
+        reply.push(Blob(np.array(
+            [self._zoo.rank, configure.applied_config_epoch(),
+             1 if applied else 0], dtype=np.int64)))
+        if reply.dst == self._zoo.rank:
+            self._zoo.route(actors.CONTROLLER, reply)
+            return
+        try:
+            # send_async, like every control-plane frame: this thread
+            # must never block toward a dead controller.
+            self._zoo.net.send_async(reply)
+        except Exception as exc:  # noqa: BLE001 - an unreachable
+            # controller re-broadcasts; the ack is observability, not
+            # correctness
+            log.debug("rank %d: config ack failed: %s",
+                      self._zoo.rank, exc)

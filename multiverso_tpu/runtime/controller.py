@@ -39,6 +39,11 @@ from ..core.blob import Blob
 from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             mark_error)
 from ..core.node import Node, is_server, is_worker
+# Module-level, not lazy: autotune's define_* calls must run before
+# zoo.start's parse_cmd_flags, or -autotune_* flags on a real command
+# line are silently left unparsed (the admission.py eager-import
+# lesson).
+from . import autotune as autotune_mod
 from . import metrics as metrics_mod
 from . import replica as replica_mod
 from ..util import log
@@ -122,6 +127,25 @@ class Controller(Actor):
         self._serving_fleet: Dict[int, tuple] = {}
         self.register_handler(MsgType.Control_Serving_Report,
                               self._process_serving_report)
+        # Closed-loop self-tuning (runtime/autotune.py,
+        # docs/AUTOTUNE.md): the manager consumes the ClusterMetrics
+        # view above and broadcasts epoch-stamped Control_Config
+        # updates; its evaluation thread only starts when
+        # -autotune_interval_s > 0 (zoo._start_observability).
+        self.autotune = autotune_mod.AutotuneManager(zoo, self.metrics)
+        self.register_handler(MsgType.Control_Reply_Config,
+                              self._process_config_ack)
+
+    def _process_config_ack(self, msg: Message) -> None:
+        """A rank's applied-config watermark (int64 [rank, epoch,
+        applied]) — pure observability: the mv_autotune_rank_epoch
+        gauges show config convergence per rank."""
+        self._note_alive(msg.src)
+        if not msg.data:
+            return
+        ack = msg.data[0].as_array(np.int64)
+        if ack.size >= 2:
+            self.autotune.note_ack(int(ack[0]), int(ack[1]))
 
     def _process_shard_done(self, msg: Message) -> None:
         self._note_alive(msg.src)
@@ -404,6 +428,12 @@ class Controller(Actor):
             # elastic state it had, but only the controller knows the
             # live epoch (docs/SHARDING.md rejoin-into-the-right-map).
             self.reshards.broadcast_all()
+            # Same for the live config: the restarted rank came up on
+            # construction-time flag values; re-broadcast the
+            # cumulative autotuned config at the current epoch so it
+            # converges immediately (docs/AUTOTUNE.md; idempotent
+            # elsewhere — epoch regression is ignored on apply).
+            self.autotune.broadcast_current()
             return
         self._register_waiting.append(msg)
         if len(self._register_waiting) != self._zoo.net_size:

@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -72,6 +74,15 @@ class MetricsReporter:
         self._report_lock = named_lock(
             f"metrics_reporter[r{zoo.rank}].report")
         self._sent_seq = 0
+        # Report ordering guard: every report carries this reporter
+        # INCARNATION (unique per reporter lifetime — a restarted/
+        # rejoined rank gets a fresh one) plus a monotonic sequence,
+        # so the controller can drop out-of-order or stale reports
+        # instead of folding them into the cluster view
+        # (ClusterMetrics.ingest).
+        self._incarnation = f"{os.getpid():x}-{id(self):x}-" \
+                            f"{time.time_ns():x}"
+        self._report_seq = 0
 
     def start(self) -> None:
         if self._interval <= 0 or self._thread is not None:
@@ -120,6 +131,9 @@ class MetricsReporter:
             payload = metrics_snapshot()
             payload["rank"] = self._zoo.rank
             payload["trace_events"] = events
+            self._report_seq += 1
+            payload["inc"] = self._incarnation
+            payload["seq"] = self._report_seq
             msg = Message(src=self._zoo.rank, dst=CONTROLLER_RANK,
                           msg_type=MsgType.Control_Metrics)
             text = json.dumps(payload).encode()
@@ -189,16 +203,67 @@ class ClusterMetrics:
         self._ranks: Dict[int, Dict] = {}  # rank -> latest snapshot
         self._trace: collections.deque = collections.deque(
             maxlen=MERGED_TRACE_CAP)
+        # Per-rank report-ordering watermark: (incarnation, seq) of
+        # the newest report folded in. A report whose seq does not
+        # advance WITHIN the same incarnation is out-of-order or stale
+        # (async send reordering; a de-parked frame from before a
+        # rank's crash) and must not roll the rank's view backward. A
+        # NEW incarnation (rank restarted/rejoined) resets the
+        # watermark — its counters legitimately start over — but a
+        # SUPERSEDED incarnation (seen before, then replaced) is a
+        # de-parked pre-crash frame and is dropped: folding it would
+        # roll the rank's view back to the dead process AND reset the
+        # watermark under it.
+        self._report_mark: Dict[int, Tuple[str, int]] = {}
+        # Ordered (dict-as-ordered-set): the cap must evict the OLDEST
+        # superseded incarnation, never the most recent predecessor —
+        # whose de-parked frames are exactly the ones to drop.
+        self._prior_incs: Dict[int, Dict[str, None]] = {}
+        self.dropped_stale = 0
+
+    #: Superseded incarnations remembered per rank (a de-parked frame
+    #: can only be from a recent predecessor; a tiny cap bounds a
+    #: crash-looping rank's footprint).
+    _PRIOR_INC_CAP = 8
 
     def ingest(self, payload: Dict) -> None:
         rank = int(payload.get("rank", -1))
         events = payload.get("trace_events") or []
+        inc = payload.get("inc")
+        seq = payload.get("seq")
+        dropped = False
         with self._lock:
-            self._ranks[rank] = {
-                "monitors": dict(payload.get("monitors") or {}),
-                "samples": dict(payload.get("samples") or {}),
-            }
-            self._trace.extend(events)
+            if seq is not None:  # pre-seq builds always fold (legacy)
+                mark = self._report_mark.get(rank)
+                if mark is not None and mark[0] == inc \
+                        and int(seq) <= mark[1]:
+                    # Same incarnation, non-advancing seq: reordered
+                    # or replayed frame.
+                    self.dropped_stale += 1
+                    dropped = True
+                elif inc in self._prior_incs.get(rank, ()):
+                    # A SUPERSEDED incarnation: a de-parked frame from
+                    # before the rank's crash arriving after its
+                    # replacement already reported.
+                    self.dropped_stale += 1
+                    dropped = True
+                else:
+                    if mark is not None and mark[0] != inc:
+                        prior = self._prior_incs.setdefault(rank, {})
+                        prior[mark[0]] = None
+                        while len(prior) > self._PRIOR_INC_CAP:
+                            del prior[next(iter(prior))]  # oldest
+                    self._report_mark[rank] = (inc, int(seq))
+            if not dropped:
+                self._ranks[rank] = {
+                    "monitors": dict(payload.get("monitors") or {}),
+                    "samples": dict(payload.get("samples") or {}),
+                }
+                self._trace.extend(events)
+        if dropped:
+            log.debug("cluster metrics: dropped stale/out-of-order "
+                      "report from rank %d (seq %s)", rank, seq)
+            count("METRICS_DROPPED_STALE")
 
     def cluster_view(self) -> Dict:
         """Per-rank and cluster-summed counters + merged percentile
@@ -236,7 +301,8 @@ class ClusterMetrics:
                 "max": data[-1]}
         return {"v": METRICS_SNAPSHOT_VERSION, "ranks": ranks,
                 "monitors_sum": monitors_sum,
-                "samples_merged": samples_merged}
+                "samples_merged": samples_merged,
+                "dropped_reports": self.dropped_stale}
 
     # -- scrape renderings --
     def prometheus_text(self) -> str:

@@ -51,7 +51,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..util.configure import define_int, get_flag
+from ..util.configure import (define_int, get_flag,
+                              register_tunable_hook)
 
 define_int("replica_hot_rows", 0,
            "hot-shard read replication budget: the controller promotes "
@@ -413,6 +414,15 @@ class ServerReplicaState:
         #: Per-holder Request_ReplicaSync send counters (gap detection
         #: on the holder side; see ``next_sync_seq``).
         self._sync_seq: Dict[int, int] = {}
+        # Live retuning (docs/AUTOTUNE.md): the controller-side budget
+        # (ReplicaCoordinator) reads -replica_hot_rows fresh per
+        # report, but this reporter cached its window size here — the
+        # hook re-sizes it so a grown budget sees enough candidates.
+        register_tunable_hook("replica_hot_rows",
+                              self._retune_budget)
+
+    def _retune_budget(self, value) -> None:
+        self._report_top = max(2 * int(value), 16)
 
     def note_get(self, rows: np.ndarray) -> None:
         if rows.size:

@@ -30,7 +30,8 @@ from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             reply_version, stamp_trace, take_error,
                             trace_of)
 from ..util import mt_queue, tracing
-from ..util.configure import define_bool, define_double, get_flag
+from ..util.configure import (define_bool, define_double, define_int,
+                              get_flag, register_tunable_hook)
 from ..util.dashboard import count as count_event
 from ..util.dashboard import monitor
 from . import actor as actors
@@ -51,10 +52,16 @@ define_double("rpc_timeout_s", 0.0,
               "0 (default) = wait without bound (the reference's "
               "behavior)")
 
-#: Flush a server's staged batch at these caps even while the mailbox is
-#: still busy — an unbounded batch would trade latency for no extra win.
-MAX_BATCH_MSGS = 64
-MAX_BATCH_BYTES = 4 << 20
+define_int("coalesce_max_msgs", 64,
+           "flush a server's staged coalesced-Add batch at this many "
+           "messages even while the mailbox is still busy — an "
+           "unbounded batch would trade latency for no extra win. "
+           "Live-retunable (docs/AUTOTUNE.md): the autotune "
+           "controller backs this off when dispatch queues sit deep")
+define_int("coalesce_max_kb", 4096,
+           "flush a server's staged coalesced-Add batch at this many "
+           "KILOBYTES of payload (the byte twin of "
+           "-coalesce_max_msgs). Live-retunable (docs/AUTOTUNE.md)")
 
 
 class Worker(Actor):
@@ -81,6 +88,18 @@ class Worker(Actor):
                           and not get_flag("sync", False))
         self._pending: Dict[int, List[Message]] = {}  # dst rank -> shards
         self._pending_bytes: Dict[int, int] = {}
+        # Flush caps, cached here off the hot staging path and
+        # live-retunable through the dynamic-flag layer
+        # (docs/AUTOTUNE.md): plain int rebinds, GIL-atomic against
+        # the actor thread's reads.
+        self._max_batch_msgs = max(int(get_flag("coalesce_max_msgs")),
+                                   1)
+        self._max_batch_bytes = \
+            max(int(get_flag("coalesce_max_kb")), 1) << 10
+        register_tunable_hook("coalesce_max_msgs",
+                              self._retune_batch_msgs)
+        register_tunable_hook("coalesce_max_kb",
+                              self._retune_batch_kb)
         # In-flight shard requests: (dst, table_id, msg_id) tracked when
         # a shard is sent (or staged), untracked when its reply lands.
         # Written only on this actor's thread; read from requester
@@ -290,13 +309,19 @@ class Worker(Actor):
                 self.send_to(actors.COMMUNICATOR, shard)
 
     # -- coalescing --
+    def _retune_batch_msgs(self, value) -> None:
+        self._max_batch_msgs = max(int(value), 1)
+
+    def _retune_batch_kb(self, value) -> None:
+        self._max_batch_bytes = max(int(value), 1) << 10
+
     def _stage_add(self, dst: int, shard: Message) -> None:
         staged = self._pending.setdefault(dst, [])
         staged.append(shard)
         self._pending_bytes[dst] = self._pending_bytes.get(dst, 0) \
             + sum(b.size for b in shard.data)
-        if (len(staged) >= MAX_BATCH_MSGS
-                or self._pending_bytes[dst] >= MAX_BATCH_BYTES):
+        if (len(staged) >= self._max_batch_msgs
+                or self._pending_bytes[dst] >= self._max_batch_bytes):
             self._flush_dst(dst)
 
     def _flush_pending(self) -> None:

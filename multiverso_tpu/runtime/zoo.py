@@ -160,19 +160,27 @@ class Zoo:
             from .metrics import MetricsReporter
             self._metrics_reporter = MetricsReporter(self)
             self._metrics_reporter.start()
+        controller = self._actors.get(actors.CONTROLLER)
+        if controller is not None \
+                and float(get_flag("autotune_interval_s", 0.0)) > 0:
+            # Closed-loop self-tuning (runtime/autotune.py,
+            # docs/AUTOTUNE.md): controller rank only, after
+            # registration — the first broadcast must be routable.
+            controller.autotune.start()
         port = int(get_flag("metrics_port", 0))
-        if port > 0 and self.rank == CONTROLLER_RANK:
+        if port > 0 and self.rank == CONTROLLER_RANK \
+                and controller is not None:
             from ..io.metrics_http import (MetricsHttpServer,
                                            json_route,
                                            prometheus_route)
-            controller = self._actors.get(actors.CONTROLLER)
-            if controller is not None:
-                self._metrics_http = MetricsHttpServer(port, {
-                    "/metrics": prometheus_route(
-                        controller.metrics.prometheus_text),
-                    "/trace.json": json_route(
-                        controller.metrics.chrome_trace_json),
-                })
+            self._metrics_http = MetricsHttpServer(port, {
+                "/metrics": prometheus_route(
+                    lambda c=controller:
+                    c.metrics.prometheus_text()
+                    + c.autotune.prometheus_text()),
+                "/trace.json": json_route(
+                    controller.metrics.chrome_trace_json),
+            })
 
     def metrics_flush(self) -> None:
         """One immediate metrics report from this rank (deterministic
@@ -222,6 +230,11 @@ class Zoo:
         if self._metrics_reporter is not None:
             self._metrics_reporter.stop()
             self._metrics_reporter = None
+        controller = self._actors.get(actors.CONTROLLER)
+        if controller is not None:
+            # The autotune thread broadcasts through the actors; it
+            # must stop before the actor teardown below.
+            controller.autotune.stop()
         if self._metrics_http is not None:
             self._metrics_http.stop()
             self._metrics_http = None

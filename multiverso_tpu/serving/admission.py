@@ -31,7 +31,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..util.configure import (define_bool, define_double, define_int,
-                              get_flag)
+                              get_flag, register_tunable_hook)
 from ..util.dashboard import count as count_event
 from ..util.lock_witness import named_condition, named_lock
 
@@ -164,6 +164,20 @@ class AdmissionController:
         self._draining = False
         self.admitted = 0
         self.shed = 0
+        # Live retuning (docs/AUTOTUNE.md): both watermarks were
+        # cached above at construction — a Control_Config broadcast
+        # lands through these hooks (weakly held; a stopped frontend's
+        # controller unregisters itself via GC).
+        register_tunable_hook("serving_max_inflight",
+                              self._retune_max_inflight)
+        register_tunable_hook("serving_shed_depth",
+                              self._retune_shed_depth)
+
+    def _retune_max_inflight(self, value) -> None:
+        self.configure(max_inflight=int(value))
+
+    def _retune_shed_depth(self, value) -> None:
+        self.configure(shed_depth=int(value))
 
     def configure(self, max_inflight: Optional[int] = None,
                   shed_depth: Optional[int] = None,

@@ -37,7 +37,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..util import log
-from ..util.configure import get_flag
+from ..util.configure import get_flag, register_tunable_hook
 from ..util.dashboard import samples
 from ..util.lock_witness import named_condition, named_lock
 
@@ -139,6 +139,28 @@ class BatchedTableReader:
                 target=self._run, daemon=True,
                 name=f"mv-serving-batch-{name}")
             self._thread.start()
+        # Live retuning (docs/AUTOTUNE.md): the batcher thread reads
+        # _window/_max_rows fresh per batch, so rebinding them is
+        # picked up on the next window (a live window change cannot
+        # START a batcher constructed with window 0 — the serve-single
+        # path stays). Registered LAST: a broadcast may fire the hooks
+        # from the recv thread immediately, and they take self._lock.
+        register_tunable_hook("serving_batch_window_ms",
+                              self._retune_window)
+        register_tunable_hook("serving_batch_max_rows",
+                              self._retune_max_rows)
+
+    # -- live retuning (dynamic-flag apply hooks) --
+    def _retune_window(self, value) -> None:
+        with self._lock:
+            self._window = max(float(value), 0.0) / 1e3
+            self._cond.notify_all()  # an open window re-reads its
+            # deadline against the new value immediately
+
+    def _retune_max_rows(self, value) -> None:
+        with self._lock:
+            self._max_rows = max(int(value), 1)
+            self._cond.notify_all()
 
     # -- the handler-thread API --
     def read(self, ids: np.ndarray):
@@ -186,12 +208,14 @@ class BatchedTableReader:
                     return
                 # Window open: collect until the deadline or the size
                 # cap, whichever first (the lone-request bound IS the
-                # window).
-                deadline = self._open_t + self._window
+                # window). The deadline re-reads _window each pass so
+                # a live retune (apply hook) re-times an OPEN window,
+                # not just the next one.
                 while (not self._stopping
                        and len(self._pending_row_set)
                        < self._max_rows):
-                    remaining = deadline - time.monotonic()
+                    remaining = self._open_t + self._window \
+                        - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(timeout=remaining)
@@ -272,6 +296,16 @@ class HotRowCache:
         self._rows: Dict[int, tuple] = {}
         self.hits = 0
         self.misses = 0
+        # Live retuning (docs/AUTOTUNE.md): capacity was cached at
+        # construction; the hook resizes a running cache.
+        register_tunable_hook("serving_hot_rows",
+                              self._retune_capacity)
+
+    def _retune_capacity(self, value) -> None:
+        with self._lock:
+            self._capacity = max(int(value), 0)
+            while len(self._rows) > self._capacity:
+                self._rows.pop(next(iter(self._rows)))
 
     def lookup(self, ids: np.ndarray):
         """All-or-nothing: every requested row fresh under the bound

@@ -54,7 +54,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..util.configure import define_int, get_flag
+from ..util.configure import (define_int, get_flag,
+                              register_tunable_hook)
 from ..util.dashboard import count
 from ..util.lock_witness import named_lock
 
@@ -186,6 +187,16 @@ class RowCache:
         #: test hook: fn(row, entry_version, latest_observed, bound),
         #: called under the cache lock for every row actually SERVED.
         self.on_hit = None
+        # Live retuning (docs/AUTOTUNE.md): the bound and capacity
+        # were cached above at construction, so a Control_Config
+        # broadcast must land through these hooks — bound methods held
+        # weakly by the registry, so a dropped table unregisters
+        # itself. Registered LAST: a broadcast may fire them from the
+        # recv thread the instant they register, and they touch the
+        # lock and row dicts above.
+        register_tunable_hook("max_get_staleness", self._retune_bound)
+        register_tunable_hook("client_cache_rows",
+                              self._retune_capacity)
 
     # -- freshness core (caller holds the lock) --
     def _fresh(self, row: int, sid: int,
@@ -212,6 +223,8 @@ class RowCache:
         copies, no counter bumps) — the prefetch planning check; an
         empty result means full coverage."""
         uniq = np.unique(row_ids)
+        if self._bound <= 0:  # inactive: everything misses
+            return uniq.astype(np.int32)
         sids = self._server_of(uniq)
         with self._lock:
             return np.asarray(
@@ -230,6 +243,10 @@ class RowCache:
         re-serve passes ``count_stats=False`` so one logical Get
         contributes exactly one hit-or-miss."""
         uniq = np.unique(row_ids)
+        if self._bound <= 0:
+            # Inactive (live-deactivated mid-flight): everything
+            # misses, nothing is counted — the old no-cache path.
+            return uniq.astype(np.int32)
         sids = self._server_of(uniq)
         fresh_vals: List[np.ndarray] = []
         fresh_keys: List[int] = []
@@ -265,6 +282,9 @@ class RowCache:
         the fetch version, are skipped — never silently resurrected."""
         if version < 0:  # unstamped legacy peer
             return
+        if self._bound <= 0:  # inactive: store nothing (a reply
+            # racing a live deactivation must not leave entries)
+            return
         with self._lock:
             if self._pending_all:
                 return
@@ -288,7 +308,23 @@ class RowCache:
     # -- own-add self-invalidation --
     def begin_add(self, row_ids: Optional[np.ndarray] = None):
         """Block the slots an own Add is about to dirty (None = whole
-        table). Returns a token for ``finish_add``."""
+        table). Returns a token for ``finish_add``.
+
+        While INACTIVE there are no entries to block, but the ack must
+        still FENCE the owning shards' floors: a Get reply served
+        before this add could land after a live activation, store the
+        pre-add value, and serve it within the widened bound — a
+        read-your-writes violation across the activation edge. The
+        fence token costs O(owning servers), not O(rows)."""
+        if self._bound <= 0:
+            if row_ids is None:
+                sids = list(range(self._num_servers))
+            else:
+                rows = np.unique(np.asarray(
+                    row_ids, dtype=np.int64).reshape(-1))
+                sids = [int(s) for s in np.unique(
+                    self._server_of(rows))]
+            return ("fence", sids)
         if row_ids is None:
             with self._lock:
                 self._pending_all += 1
@@ -308,6 +344,20 @@ class RowCache:
         slots and raise their floor to the latest observed version (the
         ack was noted before this runs), so only values fetched at-or-
         after the write serve again."""
+        if token is None:
+            return
+        if token[0] == "fence":
+            # Inactive-mode ack fence: raise the per-shard floor to
+            # the latest version observed at ack (the ack was noted
+            # before this runs). _fresh and store() both honor
+            # _floor_all, so a pre-add reply landing after a live
+            # activation can neither store nor serve.
+            with self._lock:
+                for sid in token[1]:
+                    self._floor_all[sid] = max(
+                        self._floor_all.get(sid, -1),
+                        self._tracker.latest(sid))
+            return
         rows, sids = token
         with self._lock:
             if rows is None:
@@ -330,9 +380,44 @@ class RowCache:
 
     @property
     def bound(self) -> int:
-        """The staleness bound this cache was constructed with (serving
-        tier response metadata, docs/SERVING.md)."""
+        """The LIVE staleness bound (serving tier response metadata,
+        docs/SERVING.md; retunable via the dynamic-flag layer)."""
         return self._bound
+
+    @property
+    def active(self) -> bool:
+        """False while the bound is 0: the cache object exists (so a
+        live config broadcast can activate it) but serves nothing and
+        stores nothing — the table's ``_live_cache`` treats it exactly
+        like the old no-cache construction path."""
+        return self._bound > 0
+
+    # -- live retuning (dynamic-flag apply hooks, docs/AUTOTUNE.md) --
+    def _retune_bound(self, value) -> None:
+        """``-max_get_staleness`` landed live. Widening/narrowing just
+        rebinds the freshness check; a FLIP (activation 0 -> n or
+        deactivation -> 0) also drops every entry — the cache must
+        start from scratch, never from state recorded across the
+        edge. Floors are KEPT on a flip: they only ever make serving
+        stricter, and the inactive-mode ack fences recorded in
+        ``_floor_all`` are exactly what protects read-your-writes
+        against a pre-activation reply landing late. BSP sync mode
+        keeps its force-disable (a locally served Get would bypass
+        the vector clocks)."""
+        if bool(get_flag("sync", False)):
+            value = 0
+        new = max(int(value), 0)
+        with self._lock:
+            flipped = (new > 0) != (self._bound > 0)
+            self._bound = new
+            if flipped:
+                self._rows.clear()
+
+    def _retune_capacity(self, value) -> None:
+        with self._lock:
+            self._capacity = max(int(value), 0)
+            while len(self._rows) > self._capacity:
+                self._rows.pop(next(iter(self._rows)))
 
     def versions_of(self, row_ids) -> Dict[int, int]:
         """Fetch version per requested row currently present (rows

@@ -288,7 +288,16 @@ class MatrixWorker(WorkerTable):
         # hold them without forcing a device->host copy per hit.
         bound = client_cache.staleness_bound()
         self._row_cache: Optional[RowCache] = None
-        if bound > 0 and not self.is_sparse:
+        if not self.is_sparse and not get_flag("sync", False):
+            # ALWAYS constructed on the dense host path (bound 0 =
+            # inactive pass-through, byte-identical behavior to the
+            # old no-cache construction) so the autotune layer can
+            # widen -max_get_staleness on a LIVE table — the cache's
+            # apply hooks rebind the bound; _live_cache() below keeps
+            # every hot path on the old code shape while inactive
+            # (docs/AUTOTUNE.md). Sync mode stays construction-time
+            # disabled: a locally served Get would bypass the vector
+            # clocks, so no hook may ever activate it.
             self._row_cache = RowCache(
                 bound, self._server_of_rows,
                 max(self._zoo.num_servers, self._num_server),
@@ -330,6 +339,17 @@ class MatrixWorker(WorkerTable):
             self._replica_router = replica_mod.ReplicaRouter(
                 self._num_server, salt=max(self._zoo.rank, 0),
                 preferred=local_sid if local_sid >= 0 else None)
+
+    def _live_cache(self) -> Optional[RowCache]:
+        """The row cache when ACTIVE (live bound > 0), else None — the
+        gate every read-path use site goes through, so an inactive
+        cache costs exactly one attribute check and the control flow
+        matches the pre-dynamic-flag no-cache path (the store/fetch
+        self-guards in RowCache cover mid-request deactivation)."""
+        cache = self._row_cache
+        if cache is not None and cache.active:
+            return cache
+        return None
 
     def _server_of_rows(self, rows: np.ndarray) -> np.ndarray:
         """Vectorized row ids -> owning server ids (the one sharding
@@ -465,7 +485,7 @@ class MatrixWorker(WorkerTable):
         # every requested position gets its id's row.
         self._dest_rows = row_ids
         self._device_shards = None
-        if self._row_cache is not None:
+        if self._live_cache() is not None:
             # Partial-hit serve: fresh rows fill their positions
             # locally; only the MISSING unique rows go to the wire (the
             # reply placement already handles subset keys). A fully
@@ -523,7 +543,7 @@ class MatrixWorker(WorkerTable):
         sids = self._server_of_rows(uniq)
         latest_by_sid = {int(s): self._version_tracker.latest(int(s))
                          for s in np.unique(sids)}
-        cache = self._row_cache
+        cache = self._live_cache()
         hits_before = cache.hits if cache is not None else 0
         rows_hit_before = cache.rows_hit if cache is not None else 0
         values = self.get_rows(row_ids, out)
@@ -607,7 +627,7 @@ class MatrixWorker(WorkerTable):
                          for s in np.unique(owners)}
         versions = np.full(n, -1, np.int64)
         cached = np.zeros(n, bool)
-        cache = self._row_cache
+        cache = self._live_cache()
         missing = rows
         if cache is not None:
             missing = cache.fetch_into(rows, out)
@@ -677,9 +697,9 @@ class MatrixWorker(WorkerTable):
         trainers call this for step i+1's rows while step i computes,
         overlapping wire latency with device work. Returns a request id
         (``wait`` is optional — the trainer usually never waits).
-        No-op when the cache is disabled (``-max_get_staleness=0`` or
+        No-op when the cache is inactive (``-max_get_staleness=0`` or
         BSP sync mode, where an extra Get would desync vector clocks)."""
-        if self._row_cache is None:
+        if self._live_cache() is None:
             return self._local_done()
         rows = np.unique(np.ascontiguousarray(
             row_ids, dtype=np.int32).reshape(-1))
@@ -945,10 +965,15 @@ class MatrixWorker(WorkerTable):
 
     def _cache_begin_add(self, row_ids: Optional[np.ndarray]):
         """Block the client-cache slots this Add dirties (None = whole
-        table) until its ack resolves them — read-your-writes."""
-        if self._row_cache is None:
+        table) until its ack resolves them — read-your-writes. NOT
+        gated on _live_cache(): an INACTIVE cache still needs the ack
+        to fence its shard floors, or a live activation racing an
+        in-flight add could serve the pre-add value afterwards
+        (RowCache.begin_add's fence token)."""
+        cache = self._row_cache
+        if cache is None:
             return None
-        return self._row_cache.begin_add(row_ids)
+        return cache.begin_add(row_ids)
 
     def _cache_resolve_on(self, msg_id: int, token) -> None:
         if token is not None:

@@ -17,7 +17,8 @@ one static registry per C++ type); type is enforced by the registered default.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List
+import weakref
+from typing import Any, Callable, Dict, List, Optional
 
 #: CENTRAL FLAG REGISTRY — the one canonical (default, description) per
 #: flag name, for the whole tree. ``define_*`` calls scattered across
@@ -40,6 +41,8 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     # -- server / worker actors --
     "backup_worker_ratio": 0.0,
     "coalesce_adds": True,
+    "coalesce_max_msgs": 64,
+    "coalesce_max_kb": 4096,
     # -- sharding / scale-out (runtime/communicator.py,
     #    runtime/replica.py; docs/SHARDING.md) --
     "dispatch_queues": True,
@@ -99,6 +102,11 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "trace_buffer": 4096,
     "metrics_interval_s": 0.0,
     "metrics_port": 0,
+    # -- closed-loop self-tuning (runtime/autotune.py;
+    #    docs/AUTOTUNE.md) --
+    "autotune_interval_s": 0.0,
+    "autotune_slo_p99_ms": 50.0,
+    "autotune_pin": "",
     # -- online serving tier (serving/frontend.py,
     #    serving/admission.py; docs/SERVING.md) --
     "serving_port": 0,
@@ -137,6 +145,191 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "is_pipeline": True,
     "device_pipeline": True,
 }
+
+#: LIVE-RETUNABLE FLAG REGISTRY — the subset of ``CANONICAL_FLAGS`` the
+#: closed-loop autotune layer (runtime/autotune.py, docs/AUTOTUNE.md)
+#: may change on a RUNNING cluster via an epoch-stamped
+#: ``Control_Config`` broadcast. Every entry must (a) name a canonical
+#: flag and (b) have at least one ``register_tunable_hook(...)`` call
+#: site somewhere in the tree, so hot paths that cached the value at
+#: construction (admission watermarks, cache bounds/capacities, batch
+#: caps) actually pick the change up — ``tools/mvlint``'s tunable-lint
+#: pass enforces both, parsing this literal without importing. A flag
+#: NOT listed here is rejected at broadcast time (``apply_config``
+#: raises), so a typo'd or genuinely-static knob can never be mutated
+#: mid-run. Keep the literal plain (no computed values); the value is
+#: a one-line note on how the new value lands.
+TUNABLE_FLAGS: Dict[str, str] = {
+    "max_get_staleness": "RowCache hook rebinds the live bound "
+                         "(0 deactivates and clears)",
+    "client_cache_rows": "RowCache hook resizes; eviction on next "
+                         "store",
+    "coalesce_max_msgs": "worker-actor hook re-caps staged-batch "
+                         "message flushes",
+    "coalesce_max_kb": "worker-actor hook re-caps staged-batch byte "
+                       "flushes",
+    "serving_max_inflight": "AdmissionController hook re-knobs the "
+                            "per-endpoint in-flight cap",
+    "serving_shed_depth": "AdmissionController hook re-knobs the "
+                          "mailbox-depth shed watermark",
+    "serving_batch_window_ms": "BatchedTableReader hook rewrites the "
+                               "live batch window",
+    "serving_batch_max_rows": "BatchedTableReader hook rewrites the "
+                              "live batch row cap",
+    "serving_hot_rows": "HotRowCache hook resizes the rendered-"
+                        "response capacity",
+    "replica_hot_rows": "controller reads live per report; reporter "
+                        "hook re-sizes its report window",
+    "allreduce_chunk_kb": "read per collective call; hook logs the "
+                          "handoff",
+    "wire_codec_density": "read per encoded frame; hook logs the "
+                          "handoff",
+}
+
+
+#: Registered apply hooks per tunable flag. Bound methods are held as
+#: ``weakref.WeakMethod`` so a dead owner (a table dropped between
+#: bench phases) silently unregisters instead of leaking or firing on
+#: a corpse; plain functions are held strongly. Guarded by
+#: ``_tunable_lock`` together with the applied-epoch watermark.
+_tunable_hooks: Dict[str, List] = {}
+_tunable_lock = threading.Lock()
+_applied_config_epoch = 0
+
+
+def register_tunable_hook(name: str,
+                          hook: Callable[[Any], None]) -> None:
+    """Declare how a live config change to tunable flag ``name`` lands
+    in a hot path that cached the value (docs/AUTOTUNE.md). The hook is
+    called with the freshly-coerced value after every ``apply_tunable``
+    / ``apply_config`` touching the flag; it must be idempotent and
+    cheap (it runs on the communicator's receive thread). Raises
+    ``KeyError`` for a flag not in ``TUNABLE_FLAGS`` — declaring a hook
+    for a non-tunable flag is a registration bug, not a no-op."""
+    if name not in TUNABLE_FLAGS:
+        raise KeyError(
+            f"register_tunable_hook({name!r}): not in TUNABLE_FLAGS "
+            f"(util/configure.py) — only declared-tunable flags take "
+            f"live apply hooks")
+    ref: Any
+    try:
+        # Bound methods are held weakly so a dead owner (a table
+        # dropped between bench phases) unregisters itself; plain
+        # functions and builtin bound methods hold strongly.
+        ref = weakref.WeakMethod(hook)
+    except TypeError:
+        ref = hook
+    with _tunable_lock:
+        # Prune dead weak refs HERE too, not only on fire: with
+        # autotune off no broadcast ever fires the hooks, and a
+        # process that repeatedly constructs/drops tables and
+        # frontends would otherwise grow the list without bound.
+        hooks = _tunable_hooks.setdefault(name, [])
+        hooks[:] = [r for r in hooks
+                    if not (isinstance(r, weakref.WeakMethod)
+                            and r() is None)]
+        hooks.append(ref)
+
+
+def _fire_tunable_hooks(name: str, value: Any) -> None:
+    with _tunable_lock:
+        refs = list(_tunable_hooks.get(name, ()))
+    live = []
+    for ref in refs:
+        fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
+        if fn is None:
+            continue  # owner collected: pruned below
+        live.append(ref)
+        try:
+            fn(value)
+        except Exception as exc:  # noqa: BLE001 - one mis-behaving
+            # hook must not stop the rest of the config from landing
+            from . import log
+            log.error("tunable hook for -%s failed on value %r: %s",
+                      name, value, exc)
+    if len(live) != len(refs):
+        with _tunable_lock:
+            current = _tunable_hooks.get(name)
+            if current is not None:
+                _tunable_hooks[name] = [
+                    r for r in current
+                    if not (isinstance(r, weakref.WeakMethod)
+                            and r() is None)]
+
+
+def is_tunable(name: str) -> bool:
+    return name in TUNABLE_FLAGS
+
+
+def apply_tunable(name: str, value: Any) -> Any:
+    """``set_flag`` + fire the flag's apply hooks with the coerced
+    value. The ONLY sanctioned way to change a tunable flag on a live
+    cluster — a bare ``set_flag`` would leave construction-time caches
+    (admission watermarks, batch caps, cache bounds) on the old value.
+    Raises ``KeyError`` for non-tunable flags."""
+    if name not in TUNABLE_FLAGS:
+        raise KeyError(
+            f"apply_tunable({name!r}): not in TUNABLE_FLAGS "
+            f"(util/configure.py) — non-tunable flags are rejected at "
+            f"broadcast time")
+    set_flag(name, value)
+    coerced = get_flag(name)
+    _fire_tunable_hooks(name, coerced)
+    return coerced
+
+
+def _coerce_tunable(name: str, value: Any) -> Any:
+    """Coerce ``value`` to the flag's registered (or canonical) type,
+    raising ``ValueError`` on a bad value — the pre-validation step
+    that keeps ``apply_config`` atomic."""
+    reg = FlagRegister.get()
+    typ = reg._flags[name].type if reg.has(name) \
+        else type(CANONICAL_FLAGS[name])
+    try:
+        return _coerce(value, typ)
+    except (TypeError, ValueError) as exc:
+        raise ValueError(
+            f"bad value for tunable flag -{name} "
+            f"(expected {typ.__name__}): {value!r}") from exc
+
+
+def apply_config(epoch: int, flags: Dict[str, Any]) -> bool:
+    """Apply one epoch-stamped ``Control_Config`` broadcast
+    (runtime/autotune.py). Returns False — applying NOTHING — when
+    ``epoch`` does not advance the process's applied-config watermark
+    (a replayed or reordered broadcast must not roll knobs backward).
+    Raises — before touching ANY flag or the watermark — ``KeyError``
+    if any flag is non-tunable and ``ValueError`` if any value fails
+    type coercion: a broadcast naming an undeclared flag or carrying a
+    garbage value is a controller bug and the whole update is refused,
+    never half-applied (and the consumed epoch never burned on a
+    refusal, so a corrected re-broadcast at the same epoch lands)."""
+    global _applied_config_epoch
+    bad = sorted(n for n in flags if n not in TUNABLE_FLAGS)
+    if bad:
+        raise KeyError(
+            f"config broadcast (epoch {epoch}) names non-tunable "
+            f"flag(s) {bad} — not in TUNABLE_FLAGS (util/configure.py)")
+    # Pre-coerce EVERYTHING before the watermark moves or any flag is
+    # set: a mid-loop coercion failure would otherwise leave the
+    # config half-applied with the epoch permanently consumed.
+    coerced = {name: _coerce_tunable(name, flags[name])
+               for name in sorted(flags)}
+    with _tunable_lock:
+        if int(epoch) <= _applied_config_epoch:
+            return False
+        _applied_config_epoch = int(epoch)
+    for name, value in coerced.items():
+        set_flag(name, value)
+        _fire_tunable_hooks(name, value)
+    return True
+
+
+def applied_config_epoch() -> int:
+    """The last config-broadcast epoch this process applied (0 =
+    none yet)."""
+    with _tunable_lock:
+        return _applied_config_epoch
 
 
 class _Flag:

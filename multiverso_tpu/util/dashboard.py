@@ -79,6 +79,11 @@ METRIC_NAMES: Dict[str, str] = {
     "DISPATCH_QUEUE_DEPTH[d*]": "per-destination queue depth at submit",
     # -- observability export (runtime/metrics.py) --
     "METRICS_REPORT": "per-rank metrics snapshots shipped",
+    "METRICS_DROPPED_STALE": "out-of-order/stale rank reports the "
+                             "controller aggregation dropped",
+    # -- closed-loop self-tuning (runtime/autotune.py) --
+    "AUTOTUNE_DECISION": "knob changes broadcast by the autotune "
+                         "controller",
     # -- actor mailboxes (util/mt_queue.py track_depth) --
     "MAILBOX_DEPTH[*]": "actor mailbox depth at each push",
     # -- online serving tier (serving/; docs/SERVING.md) --

@@ -54,7 +54,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from .configure import define_bool, define_double, get_flag
+from .configure import (define_bool, define_double, get_flag,
+                        register_tunable_hook)
 
 define_bool("wire_codec", True,
             "advertise + apply the compact wire codec on cross-process "
@@ -73,6 +74,19 @@ define_double("wire_codec_density", 0.5,
               "lower it when encode CPU dominates a fast local wire, "
               "raise it (toward ~0.67) when the u16-gap stream (6 "
               "B/pair) is known to engage")
+
+
+def _density_retuned(value) -> None:
+    """``-wire_codec_density`` is read fresh per encoded frame
+    (``break_even_density``), so a live retune needs no state rebind —
+    the hook declares the handoff (TUNABLE_FLAGS contract) and logs
+    the step for rank-local traceability (docs/AUTOTUNE.md)."""
+    from . import log
+    log.info("wire codec: -wire_codec_density retuned to %s (applies "
+             "from the next encoded frame)", value)
+
+
+register_tunable_hook("wire_codec_density", _density_retuned)
 
 MAGIC = b"MV"
 VERSION = 1

@@ -156,3 +156,50 @@ class TestBaselineCache:
         assert bench._baseline_est(
             "cpu_baseline", [str(src)]) == bench._PHASE_EST[
                 "cpu_baseline"]
+
+
+class TestFlagGuard:
+    def test_restores_values_set_inside(self):
+        from multiverso_tpu.util.configure import get_flag, set_flag
+        before = get_flag("max_get_staleness")
+        with bench.flag_guard():
+            set_flag("max_get_staleness", 42)
+            set_flag("trace_sample_rate", 0.5)
+            assert get_flag("max_get_staleness") == 42
+        assert get_flag("max_get_staleness") == before
+        assert get_flag("trace_sample_rate") == 0.0
+
+    def test_restores_on_exception(self):
+        from multiverso_tpu.util.configure import get_flag, set_flag
+
+        @bench.flag_guarded
+        def phase():
+            set_flag("net_pace_mbps", 99.0)
+            raise RuntimeError("mid-phase failure")
+
+        try:
+            phase()
+        except RuntimeError:
+            pass
+        assert get_flag("net_pace_mbps") == 0.0
+
+    def test_implicit_registration_restores_canonical_default(self):
+        # A tunable applied (e.g. via Control_Config) before its
+        # defining module imported is implicitly registered with
+        # default == the applied value; the guard must restore the
+        # CANONICAL default, not that accidental one — or the tuned
+        # knob would leak into every later phase's default numbers.
+        from multiverso_tpu.util.configure import (CANONICAL_FLAGS,
+                                                   FlagRegister,
+                                                   get_flag, set_flag)
+        reg = FlagRegister.get()
+        name = "serving_batch_window_ms"
+        saved = reg._flags.pop(name, None)
+        try:
+            with bench.flag_guard():
+                set_flag(name, 9.5)  # implicit registration
+                assert get_flag(name) == 9.5
+            assert get_flag(name) == CANONICAL_FLAGS[name]
+        finally:
+            if saved is not None:
+                reg._flags[name] = saved

@@ -107,18 +107,27 @@ class TestFixtures:
         # pragma'd site counts as suppressed.
         assert result.per_pass_suppressed["send-discipline"] == 1
 
+    def test_tunable_lint_seeded(self):
+        result = _fixture_result("bad_tunables.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "tunable-lint"]
+        assert len(found) == 2, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert "did you mean 'max_get_staleness'" in messages
+        assert "'port'" in messages
+
     def test_fixture_dir_fails_as_a_whole(self):
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 22
+        assert len(result.violations) == 24
         assert len(result.suppressed) == 6
 
 
 class TestCleanTree:
     def test_final_tree_is_clean(self):
         # The acceptance gate: the shipped tree has zero non-pragma'd
-        # violations across all five passes.
+        # violations across all seven passes.
         result = run(("multiverso_tpu", "tests", "bench.py"), REPO_ROOT)
         assert not result.failed, \
             "\n".join(v.render() for v in result.violations)

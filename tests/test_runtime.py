@@ -237,9 +237,8 @@ class TestAddCoalescing:
         assert batch.dst == 0
 
     def test_count_cap_flushes(self):
-        from multiverso_tpu.runtime import worker as worker_mod
         worker, zoo, table, add, Message, MsgType = self._worker()
-        for i in range(worker_mod.MAX_BATCH_MSGS):
+        for i in range(worker._max_batch_msgs):
             worker._process_add(add(i))
         batches = [m for _, m in zoo.sent
                    if m.type == MsgType.Request_BatchAdd]
@@ -319,15 +318,15 @@ class TestAddCoalescing:
         assert "boom" in fails[0][2]
 
     def test_byte_cap_flushes_exactly_at_limit(self):
-        # Staged bytes crossing MAX_BATCH_BYTES must flush mid-burst,
-        # exactly when the cap is reached — not one message later.
+        # Staged bytes crossing the -coalesce_max_kb cap must flush
+        # mid-burst, exactly when the cap is reached — not one message
+        # later.
         import numpy as np
 
         from multiverso_tpu.core.blob import Blob
         from multiverso_tpu.core.message import Message, MsgType
-        from multiverso_tpu.runtime import worker as worker_mod
         worker, zoo, table, add, _, _ = self._worker()
-        chunk = worker_mod.MAX_BATCH_BYTES // 4  # 4 shards hit the cap
+        chunk = worker._max_batch_bytes // 4  # 4 shards hit the cap
         def big_add(msg_id):
             msg = Message(src=1, dst=-1, msg_type=MsgType.Request_Add,
                           table_id=0, msg_id=msg_id)
@@ -350,19 +349,18 @@ class TestAddCoalescing:
     def test_count_cap_flushes_exactly_at_limit(self):
         # The 64th staged shard (not the 65th) must trigger the flush.
         from multiverso_tpu.core.message import unpack_add_batch
-        from multiverso_tpu.runtime import worker as worker_mod
         worker, zoo, table, add, Message, MsgType = self._worker()
-        for i in range(worker_mod.MAX_BATCH_MSGS - 1):
+        cap = worker._max_batch_msgs
+        for i in range(cap - 1):
             worker._process_add(add(i))
         assert not [m for _, m in zoo.sent
                     if m.type == MsgType.Request_BatchAdd]
-        assert len(worker._pending[0]) == worker_mod.MAX_BATCH_MSGS - 1
-        worker._process_add(add(worker_mod.MAX_BATCH_MSGS - 1))
+        assert len(worker._pending[0]) == cap - 1
+        worker._process_add(add(cap - 1))
         batches = [m for _, m in zoo.sent
                    if m.type == MsgType.Request_BatchAdd]
         assert len(batches) == 1
-        assert len(unpack_add_batch(batches[0])) \
-            == worker_mod.MAX_BATCH_MSGS
+        assert len(unpack_add_batch(batches[0])) == cap
         assert not worker._pending
 
     def test_staged_batch_survives_abort_and_drain_exit(self):

@@ -1,6 +1,6 @@
 """mvlint: project-invariant static analysis for the actor/PS runtime.
 
-Six passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+Seven passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 (see each module's docstring for the precise rules):
 
 * ``flag-lint`` — every flag access names a canonical registered flag
@@ -18,6 +18,10 @@ Six passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 * ``send-discipline`` — blocking ``net.send`` stays inside the
   transport layer; liveness/control frames ride ``send_async`` (the
   PR-6/PR-9 dispatch-thread-starvation class, now machine-checked).
+* ``tunable-lint`` — every ``TUNABLE_FLAGS`` entry names a canonical
+  flag and has a ``register_tunable_hook`` call site; every autotune
+  policy's metric input names a canonical metric
+  (``util/configure.py`` / ``runtime/autotune.py``; docs/AUTOTUNE.md).
 
 Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
 (``--baseline`` prints per-pass counts without failing). The runtime
@@ -37,6 +41,8 @@ from .framework import LintPass, RunResult, Violation, run_passes
 from .lock_lint import LockDisciplineLint
 from .metric_lint import MetricNameLint, load_metric_names
 from .send_lint import SendDisciplineLint
+from .tunable_lint import (TunableLint, load_autotune_policies,
+                           load_tunable_flags, scan_hook_sites)
 from .wire_slot_lint import (WireSlotLint, load_msg_types,
                              load_wire_slots)
 
@@ -55,6 +61,11 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         root / "multiverso_tpu" / "core" / "message.py")
     metrics = load_metric_names(
         root / "multiverso_tpu" / "util" / "dashboard.py")
+    tunables = load_tunable_flags(
+        root / "multiverso_tpu" / "util" / "configure.py")
+    policies = load_autotune_policies(
+        root / "multiverso_tpu" / "runtime" / "autotune.py")
+    hook_sites = scan_hook_sites(root / "multiverso_tpu")
     return [
         FlagLint(canonical),
         WireSlotLint(slots, root / "docs" / "WIRE_FORMAT.md",
@@ -63,6 +74,8 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         LockDisciplineLint(),
         MetricNameLint(metrics, root / "docs" / "OBSERVABILITY.md"),
         SendDisciplineLint(),
+        TunableLint(tunables, canonical, metrics, policies,
+                    hook_sites),
     ]
 
 

@@ -1,0 +1,16 @@
+"""Seeded tunable-lint violations — the pass must keep firing on these
+(ci.sh self-check: mvlint over fixtures/ must exit 1)."""
+
+from multiverso_tpu.util.configure import register_tunable_hook
+
+
+def _hook(value):
+    pass
+
+
+# VIOLATION: not a TUNABLE_FLAGS entry (typo'd name).
+register_tunable_hook("max_get_stalness", _hook)
+
+# VIOLATION: canonical but not declared tunable — would raise at
+# import time in production; must fail statically here too.
+register_tunable_hook("port", _hook)

@@ -1,0 +1,192 @@
+"""tunable-lint: the live-retunable flag registry is closed and wired.
+
+Source of truth: the ``TUNABLE_FLAGS`` literal in
+``multiverso_tpu/util/configure.py`` and the ``AUTOTUNE_POLICIES``
+literal in ``multiverso_tpu/runtime/autotune.py`` (both parsed, never
+imported). Checked:
+
+* every ``TUNABLE_FLAGS`` entry must name a ``CANONICAL_FLAGS`` flag —
+  a tunable that is not canonical could be broadcast but never parsed
+  or linted anywhere else;
+* every ``TUNABLE_FLAGS`` entry must have at least one
+  ``register_tunable_hook("name", ...)`` call site in the runtime tree
+  (pre-scanned at pass construction) — a tunable with no apply hook is
+  the exact bug the dynamic-flag layer exists to prevent: the
+  broadcast lands in the flag registry while the hot path keeps its
+  construction-time copy. Reported against configure.py;
+* every ``register_tunable_hook`` call with a literal name must name a
+  ``TUNABLE_FLAGS`` entry (per scanned file — a typo'd registration
+  raises at import time in production, but fixtures and dead code
+  paths must fail in CI too);
+* every ``AUTOTUNE_POLICIES`` key must be a ``TUNABLE_FLAGS`` entry,
+  and every policy's ``metrics`` input must name a canonical metric
+  (``util/dashboard.py METRIC_NAMES``, trailing-``*`` families
+  honored via ``metric_lint.family_match``) — a policy steering on a
+  typo'd signal silently holds forever.
+
+Non-literal names are skipped, the same contract as flag-lint.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Set
+
+from .framework import LintPass, ModuleInfo, Violation
+from .metric_lint import family_match
+
+HOOK_FN = "register_tunable_hook"
+
+
+def _load_dict_literal(path: Path, name: str) -> Dict[str, Any]:
+    tree = ast.parse(path.read_text(encoding="utf-8"))
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == name:
+                value = ast.literal_eval(node.value)
+                if isinstance(value, dict):
+                    return value
+    raise RuntimeError(f"no {name} dict literal in {path}")
+
+
+def load_tunable_flags(configure_path: Path) -> Dict[str, str]:
+    """The TUNABLE_FLAGS literal, by AST parse of configure.py."""
+    return _load_dict_literal(configure_path, "TUNABLE_FLAGS")
+
+
+def load_autotune_policies(autotune_path: Path) -> Dict[str, dict]:
+    """The AUTOTUNE_POLICIES literal, by AST parse of autotune.py."""
+    return _load_dict_literal(autotune_path, "AUTOTUNE_POLICIES")
+
+
+def _literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def scan_hook_sites(tree_root: Path) -> Set[str]:
+    """Every flag name passed as a literal first argument to
+    ``register_tunable_hook`` anywhere under ``tree_root`` — the
+    hook-coverage fact the per-registry check needs (a hook may live
+    in any layer: tables, serving, runtime, util)."""
+    names: Set[str] = set()
+    for path in sorted(tree_root.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue  # the runner reports parse errors itself
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            fn_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if fn_name != HOOK_FN:
+                continue
+            name = _literal_str(node.args[0])
+            if name is not None:
+                names.add(name)
+    return names
+
+
+class TunableLint(LintPass):
+    name = "tunable-lint"
+
+    def __init__(self, tunables: Dict[str, str],
+                 canonical: Dict[str, Any],
+                 metrics: Dict[str, str],
+                 policies: Dict[str, dict],
+                 hook_sites: Set[str]):
+        self.tunables = tunables
+        self.canonical = canonical
+        self.metrics = metrics
+        self.policies = policies
+        self.hook_sites = hook_sites
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        if module.path.name == "configure.py" \
+                and "util" in module.path.parts:
+            yield from self._check_registry(module)
+            return  # the registry/hook layer itself defines the API
+        if module.path.name == "autotune.py" \
+                and "runtime" in module.path.parts:
+            yield from self._check_policies(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            fn_name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if fn_name != HOOK_FN:
+                continue
+            name = _literal_str(node.args[0])
+            if name is None or name in self.tunables:
+                continue
+            import difflib
+            close = difflib.get_close_matches(
+                name, sorted(self.tunables), n=1)
+            hint = f" — did you mean {close[0]!r}?" if close else ""
+            yield Violation(
+                module.rel, node.lineno, node.col_offset, self.name,
+                f"{HOOK_FN}({name!r}): not in TUNABLE_FLAGS "
+                f"(util/configure.py) — a hook for a non-tunable flag "
+                f"raises at import time{hint}")
+
+    def _check_registry(self, module: ModuleInfo) -> Iterator[Violation]:
+        """Registry closure, reported against configure.py: every
+        tunable is canonical AND has an apply-hook call site."""
+        for name in sorted(self.tunables):
+            if name not in self.canonical:
+                yield Violation(
+                    module.rel, 1, 0, self.name,
+                    f"TUNABLE_FLAGS entry {name!r} is not in "
+                    f"CANONICAL_FLAGS — a tunable must be a canonical "
+                    f"flag first")
+            if name not in self.hook_sites:
+                yield Violation(
+                    module.rel, 1, 0, self.name,
+                    f"TUNABLE_FLAGS entry {name!r} has no "
+                    f"register_tunable_hook(...) call site in the "
+                    f"tree — a broadcast would land in the flag "
+                    f"registry while every construction-time copy "
+                    f"keeps the old value (docs/AUTOTUNE.md)")
+
+    def _check_policies(self, module: ModuleInfo) -> Iterator[Violation]:
+        for knob in sorted(self.policies):
+            if knob not in self.tunables:
+                yield Violation(
+                    module.rel, 1, 0, self.name,
+                    f"AUTOTUNE_POLICIES key {knob!r} is not in "
+                    f"TUNABLE_FLAGS (util/configure.py) — the "
+                    f"controller would broadcast a flag every rank "
+                    f"rejects")
+            policy = self.policies[knob]
+            for metric in policy.get("metrics", ()):
+                if family_match(metric, self.metrics):
+                    continue
+                import difflib
+                close = difflib.get_close_matches(
+                    metric, sorted(self.metrics), n=1)
+                hint = f" — did you mean {close[0]!r}?" if close else ""
+                yield Violation(
+                    module.rel, 1, 0, self.name,
+                    f"AUTOTUNE_POLICIES[{knob!r}] reads metric "
+                    f"{metric!r} which is not in the canonical metric "
+                    f"registry (util/dashboard.py METRIC_NAMES) — the "
+                    f"policy would steer on a signal nobody emits"
+                    f"{hint}")
+
+    def tree_report(self) -> List[str]:
+        unpolicied = sorted(set(self.tunables) - set(self.policies))
+        if not unpolicied:
+            return []
+        return [f"tunable-lint: tunables without an autotune policy "
+                f"(broadcast-able, never moved autonomously): "
+                f"{', '.join(unpolicied)}"]
