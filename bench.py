@@ -1322,6 +1322,138 @@ def run_wire_codec() -> dict:
 
 
 @flag_guarded
+def _wire_pump(zero_copy: bool, n_msgs: int, rows: int,
+               dims: int = 256) -> dict:
+    """One arm of the ``zero_copy`` phase: large-blob PS-shaped traffic
+    over loopback TCP — rank 0 streams ``n_msgs`` Get replies' worth of
+    (rows x dims) fp32 payload to rank 1, which echoes each frame's
+    blob straight back (the serving read shape: big payloads both
+    directions, and the echo re-serializes RECEIVED view-backed blobs).
+    Serialization — not the wire — dominates on loopback, which is
+    exactly where the copy count shows. Returns rows/s and the measured
+    copied-bytes-per-payload-byte off the WIRE_BYTES_COPIED /
+    WIRE_PAYLOAD_BYTES counters."""
+    import threading
+    from multiverso_tpu.core.blob import Blob
+    from multiverso_tpu.core.message import Message, MsgType
+    from multiverso_tpu.runtime.tcp import TcpNet
+    from multiverso_tpu.util.configure import set_flag
+    from multiverso_tpu.util.dashboard import Dashboard
+    from multiverso_tpu.util.net_util import free_listen_port
+
+    set_flag("zero_copy", zero_copy)
+    set_flag("buffer_pool_mb", 32 if zero_copy else 0)
+    Dashboard.reset()
+    eps = [f"127.0.0.1:{free_listen_port()}" for _ in range(2)]
+    nets = [TcpNet(r, eps) for r in range(2)]
+    try:
+        payload = np.arange(rows * dims, dtype=np.float32)
+        errs = []
+
+        def echo():
+            try:
+                for _ in range(n_msgs):
+                    msg = nets[1].recv(timeout=120)
+                    assert msg is not None
+                    reply = msg.create_reply_message()
+                    reply.data = list(msg.data)  # re-send the view
+                    nets[1].send(reply)
+            except Exception as exc:  # noqa: BLE001 - surfaced below
+                errs.append(exc)
+
+        server = threading.Thread(target=echo, daemon=True)
+        server.start()
+        window = 4
+        inflight = 0
+        t0 = time.perf_counter()
+        for i in range(n_msgs):
+            msg = Message(src=0, dst=1, msg_type=MsgType.Request_Get,
+                          msg_id=i)
+            msg.push(Blob(payload))
+            nets[0].send(msg)
+            inflight += 1
+            if inflight >= window:
+                assert nets[0].recv(timeout=120) is not None
+                inflight -= 1
+        for _ in range(inflight):
+            assert nets[0].recv(timeout=120) is not None
+        elapsed = time.perf_counter() - t0
+        server.join(timeout=30)
+        assert not errs, errs
+        copied = Dashboard.get("WIRE_BYTES_COPIED").count
+        payload_bytes = Dashboard.get("WIRE_PAYLOAD_BYTES").count
+        pool_hits = Dashboard.get("POOL_HIT").count
+        pool_miss = Dashboard.get("POOL_MISS").count
+        total_rows = n_msgs * rows * 2  # both directions
+        return {
+            "rows_per_sec": round(total_rows / elapsed, 0),
+            "payload_mb_per_sec": round(
+                n_msgs * payload.nbytes * 2 / elapsed / 1e6, 1),
+            "sec": round(elapsed, 4),
+            "copied_bytes_per_payload_byte": round(
+                copied / max(payload_bytes, 1), 6),
+            "pool_hits": pool_hits, "pool_misses": pool_miss,
+        }
+    finally:
+        for n in nets:
+            n.finalize()
+
+
+def run_zero_copy() -> dict:
+    """Zero-copy wire-path phase (docs/MEMORY.md): the scatter-gather +
+    pooled-receive path vs the legacy join/tobytes baseline
+    (``-zero_copy=0``) on the SAME traffic — large-blob PS echoes and a
+    dense 2-rank ring allreduce over loopback TCP. Acceptance: the
+    copied-bytes-per-payload-byte ratio drops >=2x and rows/s improves
+    on the large-blob arm; frames stay byte-identical (the golden
+    check below + tests/test_zero_copy.py)."""
+    from multiverso_tpu.core.blob import Blob
+    from multiverso_tpu.core.message import Message, MsgType
+    from multiverso_tpu.runtime.tcp import _serialize, serialize_views
+
+    # Inline golden proof on a representative frame: the two
+    # serializers emit identical bytes, so the bench's two arms (and
+    # mixed-build clusters) speak one wire format.
+    probe = Message(src=0, dst=1, msg_type=MsgType.Request_Get,
+                    msg_id=77)
+    probe.push(Blob(np.arange(4096, dtype=np.float32)))
+    probe.push(Blob(b"text payload"))
+    views, nbytes = serialize_views(probe)
+    flat = _serialize(probe)
+    identical = b"".join(bytes(v) for v in views) == flat \
+        and nbytes == len(flat)
+
+    n_msgs, rows = 64, 4096  # 4 MB blobs: an embedding-table Get reply
+    zc = _wire_pump(True, n_msgs, rows)
+    base = _wire_pump(False, n_msgs, rows)
+    out = {
+        "frames_byte_identical": identical,
+        "blob_mb": round(rows * 256 * 4 / 1e6, 2),
+        "zero_copy": zc,
+        "copy_baseline": base,
+        "copied_ratio_improvement": round(
+            base["copied_bytes_per_payload_byte"]
+            / max(zc["copied_bytes_per_payload_byte"], 1e-9), 1),
+        "rows_per_sec_speedup": round(
+            zc["rows_per_sec"] / max(base["rows_per_sec"], 1), 3),
+    }
+    # Allreduce over loopback: the collective's segment frames ride the
+    # same framer; dense 4 MB fp32, forced ring, codec on (RAW frames
+    # pass the payload as a zero-copy view).
+    with flag_guard():
+        from multiverso_tpu.util.configure import set_flag
+        set_flag("zero_copy", True)
+        ar_zc = _allreduce_world(2, "ring", 0.0, False, "tcp", 1 << 20)
+        set_flag("zero_copy", False)
+        set_flag("buffer_pool_mb", 0)
+        ar_base = _allreduce_world(2, "ring", 0.0, False, "tcp", 1 << 20)
+    out["allreduce"] = {
+        "zero_copy": ar_zc, "copy_baseline": ar_base,
+        "speedup": round(ar_base["sec"] / max(ar_zc["sec"], 1e-9), 3)}
+    return out
+
+
+@flag_guarded
 def _allreduce_world(world: int, algo: str, pace_mbps: float,
                      lossy: bool, transport: str, n_elems: int,
                      reps: int = 2, fill: float = 1.0,
@@ -3250,7 +3382,8 @@ _PHASE_EST = {
     "ps_two_workers": 60, "ps_two_servers": 150,
     "tcp_one_process": 65, "tcp_two_process": 110,
     "matrix_bandwidth": 60, "local_retime": 60,
-    "wire_codec": 15, "client_cache": 45, "allreduce": 260,
+    "wire_codec": 15, "zero_copy": 45, "client_cache": 45,
+    "allreduce": 260,
     "observability": 60, "elastic": 110, "autotune": 120,
 }
 
@@ -3431,6 +3564,9 @@ def main() -> None:
     codec = result.run("wire_codec", run_wire_codec)
     if codec:
         result.merge(wire_codec=codec)
+    zero_copy = result.run("zero_copy", run_zero_copy)
+    if zero_copy:
+        result.merge(zero_copy=zero_copy)
     allreduce = result.run("allreduce", run_allreduce)
     if allreduce:
         result.merge(allreduce=allreduce)

@@ -8,7 +8,9 @@ cd "$(dirname "$0")"
 echo "== mvlint static-analysis gate =="
 # Project invariants, machine-checked before anything runs: flag
 # registry, wire-slot registry (cross-checked vs docs/WIRE_FORMAT.md),
-# device-dispatch guarding, lock discipline. Fails on any non-pragma'd
+# device-dispatch guarding, lock discipline, copy discipline on the
+# zero-copy wire path (cross-checked vs docs/MEMORY.md). Fails on any
+# non-pragma'd
 # violation and prints file:line diagnostics; the trailing summary
 # shows per-pass counts. (`python -m tools.mvlint --baseline ...`
 # prints the same counts WITHOUT failing — drift-at-a-glance for PRs.)
@@ -47,6 +49,18 @@ echo "== fast wire-codec + client-cache + allreduce subsets =="
 # codec frames, the versioned cache, or the collective engine must name
 # itself, not hide inside the full run's output.
 python -m pytest tests/test_wire_codec.py tests/test_client_cache.py -x -q
+
+echo "== zero-copy wire path subset (golden frames / buffer pool / COW) =="
+# The zero-copy transport invariants get their own named gate: frame
+# byte-identity between the scatter-gather framer and the legacy flat
+# serializer (header slots 0-9, codec frames, batch descriptors — the
+# no-wire-break proof), buffer-pool lease safety (a blob-outlived array
+# is never aliased by a recycled frame), the read-only/materialize
+# copy-on-write contract, and TCP round trips with the pool active
+# (tests/test_zero_copy.py; docs/MEMORY.md). The static half — mvlint
+# pass 8 copy-lint, banning tobytes/bytes()/join on wire-path modules —
+# already ran in the mvlint block above.
+python -m pytest tests/test_zero_copy.py -x -q
 
 echo "== sparse-allreduce subset (index-union reduce / switchover / sharded avg) =="
 # The sparse collective tier gets its own named gate: choose_algo path

@@ -207,6 +207,18 @@ class Message:
             blob = Blob(np.ascontiguousarray(blob))
         self.data.append(blob)
 
+    def text_payload(self, index: int = 0,
+                     errors: str = "replace") -> str:
+        """UTF-8 text of payload blob ``index``, decoded straight from
+        the blob's uint8 view — no intermediate ``bytes(...)`` copy.
+        THE reader for every JSON/error-text payload on the wire
+        (error replies, serving-fleet aggregates, Control_Config
+        broadcasts, metrics snapshots): one helper instead of five
+        scattered ``bytes(blob.as_array(np.uint8)).decode()`` sites,
+        and the one place the decode policy (``errors``) lives."""
+        arr = np.ascontiguousarray(self.data[index].as_array(np.uint8))
+        return str(memoryview(arr), "utf-8", errors)
+
     def size(self) -> int:
         return len(self.data)
 
@@ -246,7 +258,7 @@ def take_error(msg: "Message") -> Optional[str]:
     if msg.header[ERROR_SLOT] == 0:
         return None
     if msg.data:
-        return bytes(msg.data[0].as_array(np.uint8)).decode(errors="replace")
+        return msg.text_payload()
     return "remote table operation failed"
 
 

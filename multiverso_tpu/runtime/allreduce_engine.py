@@ -73,8 +73,8 @@ from ..util.configure import (define_bool, define_double, define_int,
                               register_tunable_hook)
 from ..util.dashboard import samples
 from ..util.wire_codec import (CODEC_SLOT, break_even_density, decode_blob,
-                               decode_blob_sparse, density_of, encode_blob,
-                               worth_encoding)
+                               decode_blob_sparse, density_of,
+                               encode_blob_views, worth_encoding)
 from .net import NetInterface
 
 define_string("allreduce_algo", "auto",
@@ -292,8 +292,10 @@ class AllreduceEngine:
         # trip a RAW frame would cost.
         if self._codec and payload.nbytes >= _CODEC_MIN_BYTES \
                 and worth_encoding(payload):
-            frame, _ = encode_blob(payload)  # lossless tiers only
-            self._post(dst, Blob(np.frombuffer(frame, np.uint8)), tag, True)
+            # Lossless tiers only; the (header, streams) parts ride the
+            # scatter-gather framer unjoined (docs/MEMORY.md).
+            parts, _ = encode_blob_views(payload)
+            self._post(dst, Blob.from_parts(parts), tag, True)
         else:
             self._post(dst, Blob(payload), tag, False)
 
@@ -312,9 +314,9 @@ class AllreduceEngine:
             ef[lo:hi] = 0.0
             self._send(dst, vals, tag)
             return vals
-        frame, residual = encode_blob(vals, lossy=True)
+        parts, residual = encode_blob_views(vals, lossy=True)
         ef[lo:hi] = residual if residual is not None else 0.0
-        self._post(dst, Blob(np.frombuffer(frame, np.uint8)), tag, True)
+        self._post(dst, Blob.from_parts(parts), tag, True)
         # decoded == vals - residual; reconstruct instead of re-decoding.
         return vals - ef[lo:hi]
 
@@ -568,6 +570,12 @@ class AllreduceEngine:
                     ag_recv(pending.popleft())
             while pending:
                 ag_recv(pending.popleft())
+        # Queued async frames are zero-copy VIEWS of ``flat`` now
+        # (scatter-gather framing): drain them before handing the
+        # buffer to the caller, who is free to mutate the result. The
+        # old path paid a serialize-time copy per frame instead; the
+        # flush costs one wait for writes already in flight.
+        self._net.flush_sends()
         return flat.reshape(shape)
 
     # -- sparse-stream tier (SparCML-style index+value collectives) ----
@@ -644,9 +652,8 @@ class AllreduceEngine:
         in-process snapshot copy)."""
         payload = np.ascontiguousarray(payload)
         if payload.nbytes >= _CODEC_MIN_BYTES and worth_encoding(payload):
-            frame, _ = encode_blob(payload)
-            self._post(dst, Blob(np.frombuffer(frame, np.uint8)), tag,
-                       True)
+            parts, _ = encode_blob_views(payload)
+            self._post(dst, Blob.from_parts(parts), tag, True)
         else:
             self._send(dst, payload, tag)
 
@@ -729,18 +736,18 @@ class AllreduceEngine:
             ef = self._ef_buffer("spag", out.size)
             vals = acc + ef[lo:hi]
             if vals.nbytes >= _CODEC_MIN_BYTES:
-                frame, residual = encode_blob(vals, lossy=True)
+                parts, residual = encode_blob_views(vals, lossy=True)
                 ef[lo:hi] = residual if residual is not None else 0.0
             else:  # sub-threshold: exact, pending residual consumed
-                frame, _ = encode_blob(vals)
+                parts, _ = encode_blob_views(vals)
                 ef[lo:hi] = 0.0
             # decoded == vals - residual; every rank lands on this.
             own_vals = vals - ef[lo:hi]
-            carry, encoded = Blob(np.frombuffer(frame, np.uint8)), True
+            carry, encoded = Blob.from_parts(parts), True
         elif acc.nbytes >= _CODEC_MIN_BYTES and worth_encoding(acc):
-            frame, _ = encode_blob(acc)
+            parts, _ = encode_blob_views(acc)
             own_vals = acc
-            carry, encoded = Blob(np.frombuffer(frame, np.uint8)), True
+            carry, encoded = Blob.from_parts(parts), True
         else:
             own_vals = acc
             carry, encoded = Blob(acc), False
@@ -834,6 +841,10 @@ class AllreduceEngine:
         flat = np.concatenate(gathered)
         if self.rank < surplus:
             self._send(self.rank + pow2, flat, _RH_RESULT)
+        # The queued exchange/result frames view ``flat`` and the round
+        # segments directly (scatter-gather framing): drain before the
+        # caller may mutate the returned buffer.
+        self._net.flush_sends()
         return flat.reshape(np.asarray(data).shape)
 
     def _gather_segments(self, my_seg, bounds, dtype, tag) -> list:

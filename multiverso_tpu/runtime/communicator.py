@@ -430,8 +430,7 @@ class Communicator(Actor):
             # must not fall through to the Zoo mailbox.
             try:
                 import json
-                doc = json.loads(bytes(
-                    msg.data[0].as_array(np.uint8)).decode())
+                doc = json.loads(msg.text_payload())
             except Exception:  # noqa: BLE001 - a malformed aggregate
                 # must not kill the recv thread; the next report
                 # replaces it
@@ -521,8 +520,7 @@ class Communicator(Actor):
         import json
         from ..util import configure
         try:
-            doc = json.loads(bytes(
-                msg.data[0].as_array(np.uint8)).decode())
+            doc = json.loads(msg.text_payload())
             epoch = int(doc["epoch"])
             flags = dict(doc["flags"])
         except Exception:  # noqa: BLE001 - a malformed broadcast must

@@ -165,8 +165,7 @@ def parse_report(msg: Message) -> Optional[Dict]:
     if not msg.data:
         return None
     try:
-        payload = json.loads(
-            bytes(msg.data[0].as_array(np.uint8)).decode())
+        payload = json.loads(msg.text_payload())
     except Exception:  # noqa: BLE001
         return None
     if not isinstance(payload, dict) \

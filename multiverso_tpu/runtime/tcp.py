@@ -11,10 +11,17 @@ and no libzmq:
   connection per peer (full mesh, like the reference's per-peer DEALER
   sockets, ref: zmq_net.h:25-61);
 - messages travel as length-prefixed frames: ``[total u64][header 10xi32]
-  [nblobs u32][blob sizes u64 x n][blob bytes ...]`` — the same
-  "serialize whole message into one flat buffer" shape as the reference's
-  MPI path (ref: mpi_net.h:289-317), with device blobs materialized to
-  host bytes at the wire boundary;
+  [nblobs u32][blob sizes u64 x n][blob bytes ...]`` — the same frame
+  LAYOUT as the reference's MPI path (ref: mpi_net.h:289-317), but built
+  zero-copy: the send side never joins the frame into one flat buffer
+  (``serialize_views`` emits a small header buffer plus one view per
+  blob payload, drained by ``socket.sendmsg`` vectored writes straight
+  out of the Blobs' own memory), and the receive side leases a pooled
+  buffer (``util/buffer_pool.py``), fills it with ``recv_into``, and
+  cuts read-only Blob views directly from the frame. Device blobs still
+  materialize to host bytes at the wire boundary. ``-zero_copy=0``
+  falls back to the flat join/copy path (byte-identical frames — the
+  bench baseline and the mixed-build escape hatch);
 - bootstrap is machine-file driven (one ``host[:port]`` per line, own rank
   found by local-address match or the ``-rank`` flag,
   ref: zmq_net.h:20-28,25-61) or app-driven via ``net_bind``/
@@ -39,9 +46,10 @@ import numpy as np
 from ..core.blob import Blob
 from ..core.message import HEADER_SIZE, Message, trace_of
 from ..util import chaos, log, tracing
-from ..util.configure import (define_double, define_int, define_string,
-                              get_flag)
-from ..util.dashboard import monitor
+from ..util.buffer_pool import BufferPool
+from ..util.configure import (define_bool, define_double, define_int,
+                              define_string, get_flag)
+from ..util.dashboard import count, monitor
 from ..util.lock_witness import (acquire_timeout, named_condition,
                                  named_lock)
 from ..util.mt_queue import MtQueue
@@ -63,6 +71,14 @@ define_double("connect_timeout_s", 30.0,
               "restart window of a crashed peer (a send toward a dead "
               "rank blocks in connect-retry until the replacement "
               "process binds, then delivers)")
+define_bool("zero_copy", True,
+            "scatter-gather wire path: serialize outbound frames as "
+            "view lists drained by sendmsg vectored writes (no flat "
+            "join), and deserialize inbound frames as read-only Blob "
+            "views into pooled receive buffers (-buffer_pool_mb). "
+            "Frames are byte-identical either way (golden-tested) — "
+            "0 restores the legacy join/copy path as the bench "
+            "baseline and a diagnostics escape hatch")
 define_double("net_pace_mbps", 0.0,
               "emulate a constrained wire: pace outbound frames to this "
               "many megabits/s. The sleep happens BEFORE each write "
@@ -88,39 +104,143 @@ def _parse_endpoint(line: str, default_port: int) -> Tuple[str, int]:
     return line, default_port
 
 
-def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    """Read exactly n bytes; None on orderly EOF."""
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+    """Read exactly n bytes; None on orderly EOF. Returns the filled
+    ``bytearray`` itself — a ``bytes(buf)`` copy here used to tax every
+    inbound frame once for nothing (struct unpacks and numpy views read
+    a bytearray directly)."""
     buf = bytearray(n)
-    view = memoryview(buf)
+    return buf if _recv_into_exact(sock, memoryview(buf)) else None
+
+
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
+    """Fill ``view`` completely from the socket; False on orderly EOF.
+    The zero-copy twin of ``_read_exact``: the caller owns the buffer
+    (a pooled frame lease), so nothing is allocated here."""
+    n = view.nbytes
     got = 0
     while got < n:
         k = sock.recv_into(view[got:], n - got)
         if k == 0:
-            return None
+            return False
         got += k
-    return bytes(buf)
+    return True
 
 
-def _serialize(msg: Message) -> bytes:
-    parts: List[bytes] = []
-    blobs: List[bytes] = []
+def serialize_views(msg: Message) -> Tuple[List[memoryview], int]:
+    """Scatter-gather framer: the wire frame as ``(views, nbytes)``
+    where ``views[0]`` is the length-prefix + header + blob-size table
+    (the only bytes this function builds) and every following view
+    reads straight through ``Blob.wire_views()`` into the payload's own
+    memory — no per-blob ``tobytes``, no ``b"".join``, no prefix
+    concat. Drained by ``sendmsg`` vectored writes; joining the views
+    reproduces ``_serialize``'s frame byte for byte (golden-tested),
+    so the wire format is unchanged and mixed -zero_copy builds
+    interoperate."""
+    views: List[memoryview] = [memoryview(b"")]  # head placeholder
+    sizes: List[int] = []
+    payload = 0
     for blob in msg.data:
         # Device payloads cross the wire as host bytes (the reference's
         # serialize step; ref: mpi_net.h:289-317). Codec-filtered blobs
         # (header slot CODEC_SLOT set by the communicator) are already
-        # uint8 frames and pass through unchanged.
-        blobs.append(blob.wire_bytes().tobytes())
+        # uint8 frames — possibly in scatter-gather parts — and pass
+        # through unchanged.
+        nbytes = 0
+        for view in blob.wire_views():
+            nbytes += view.nbytes
+            if view.nbytes:  # zero-length views would stall sendmsg
+                views.append(view)
+        sizes.append(nbytes)
+        payload += nbytes
+    body = _HDR.size + _NBLOBS.size + _LEN.size * len(sizes) + payload
+    head = bytearray(_LEN.size + _HDR.size + _NBLOBS.size
+                     + _LEN.size * len(sizes))
+    _LEN.pack_into(head, 0, body)
+    _HDR.pack_into(head, _LEN.size, *[int(v) for v in msg.header])
+    off = _LEN.size + _HDR.size
+    _NBLOBS.pack_into(head, off, len(sizes))
+    off += _NBLOBS.size
+    for sz in sizes:
+        _LEN.pack_into(head, off, sz)
+        off += _LEN.size
+    views[0] = memoryview(head)
+    # Copy accounting (docs/MEMORY.md): only the framing bytes are
+    # built here; payload bytes go to the wire without a host copy.
+    count("WIRE_BYTES_COPIED", len(head))
+    count("WIRE_PAYLOAD_BYTES", payload)
+    return views, _LEN.size + body
+
+
+#: Buffers per sendmsg call — conservatively under IOV_MAX (1024 on
+#: Linux); a frame with more views loops.
+_IOV_CAP = 64
+
+
+def _sendmsg_all(sock: socket.socket, views: List[memoryview]) -> None:
+    """Drain ``views`` through vectored writes, handling partial sends
+    (sendmsg may stop mid-view under backpressure). Views must be
+    non-empty (``serialize_views`` filters zero-length ones)."""
+    i = 0
+    off = 0
+    n = len(views)
+    while i < n:
+        if off:
+            batch = [views[i][off:]]
+            batch.extend(views[i + 1:i + _IOV_CAP])
+        else:
+            batch = views[i:i + _IOV_CAP]
+        sent = sock.sendmsg(batch)
+        while i < n and sent:
+            remaining = views[i].nbytes - off
+            if sent >= remaining:
+                sent -= remaining
+                i += 1
+                off = 0
+            else:
+                off += sent
+                sent = 0
+
+
+def _frame_views(msg: Message) -> Tuple[List[memoryview], int]:
+    """The outbound frame as vectored-write views: scatter-gather by
+    default, a single view of the legacy flat frame under
+    ``-zero_copy=0`` (identical bytes either way)."""
+    if bool(get_flag("zero_copy")):
+        return serialize_views(msg)
+    frame = _serialize(msg)
+    return [memoryview(frame)], len(frame)
+
+
+def _serialize(msg: Message) -> bytes:
+    """Flat-buffer serializer — the LEGACY path (``-zero_copy=0``), the
+    golden reference the scatter-gather framer is byte-compared
+    against, and the bench baseline whose copy count the zero-copy path
+    is measured by. Each payload byte is copied ~3x here (per-blob
+    tobytes, the join, the length-prefix concat)."""
+    parts: List[bytes] = []
+    blobs: List[bytes] = []
+    payload = 0
+    for blob in msg.data:
+        blobs.append(blob.wire_bytes().tobytes())  # mvlint: ignore[copy-lint]
+        payload += len(blobs[-1])
     header = _HDR.pack(*[int(v) for v in msg.header])
     parts.append(header)
     parts.append(_NBLOBS.pack(len(blobs)))
     for b in blobs:
         parts.append(_LEN.pack(len(b)))
     parts.extend(blobs)
-    body = b"".join(parts)
-    return _LEN.pack(len(body)) + body
+    body = b"".join(parts)  # mvlint: ignore[copy-lint]
+    frame = _LEN.pack(len(body)) + body
+    count("WIRE_BYTES_COPIED", payload + len(body) + len(frame))
+    count("WIRE_PAYLOAD_BYTES", payload)
+    return frame
 
 
-def _deserialize(body: bytes) -> Message:
+def _deserialize(body) -> Message:
+    """Flat-buffer parser — the LEGACY path (``-zero_copy=0``): every
+    payload byte is copied out of the frame into a private Blob
+    array."""
     header = _HDR.unpack_from(body, 0)
     msg = Message()
     msg.header = list(header)
@@ -128,6 +248,7 @@ def _deserialize(body: bytes) -> Message:
     (nblobs,) = _NBLOBS.unpack_from(body, off)
     off += _NBLOBS.size
     sizes = []
+    payload = 0
     for _ in range(nblobs):
         (sz,) = _LEN.unpack_from(body, off)
         sizes.append(sz)
@@ -135,21 +256,57 @@ def _deserialize(body: bytes) -> Message:
     for sz in sizes:
         msg.data.append(Blob(np.frombuffer(body, np.uint8, sz, off).copy()))
         off += sz
+        payload += sz
+    count("WIRE_BYTES_COPIED", payload)
+    count("WIRE_PAYLOAD_BYTES", payload)
+    return msg
+
+
+def _deserialize_frame(body: memoryview, lease) -> Message:
+    """Zero-copy parser: Blobs are READ-ONLY numpy views straight into
+    the leased receive frame; ``lease`` rides every Blob and returns
+    the buffer to the pool when the last one dies
+    (util/buffer_pool.py). Mutating consumers must
+    ``Blob.materialize()`` first — the copy-on-write contract
+    (docs/MEMORY.md)."""
+    header = _HDR.unpack_from(body, 0)
+    msg = Message()
+    msg.header = list(header)
+    off = _HDR.size
+    (nblobs,) = _NBLOBS.unpack_from(body, off)
+    off += _NBLOBS.size
+    sizes = []
+    payload = 0
+    for _ in range(nblobs):
+        (sz,) = _LEN.unpack_from(body, off)
+        sizes.append(sz)
+        off += _LEN.size
+    for sz in sizes:
+        arr = np.frombuffer(body, np.uint8, sz, off)
+        arr.flags.writeable = False
+        msg.data.append(Blob.from_lease(arr, lease))
+        off += sz
+        payload += sz
+    count("WIRE_PAYLOAD_BYTES", payload)
     return msg
 
 
 class _PeerWriter:
     """Per-destination writer thread + bounded frame queue.
 
-    ``send_async`` enqueues serialized frames here; the thread drains
-    them through the shared per-destination socket (under the same
-    ``_out_locks[dst]`` the blocking path takes, so async and sync
-    frames never interleave mid-write). Backpressure: ``submit`` blocks
-    once ``-send_queue_mb`` of serialized bytes are queued — a runaway
-    producer degrades to the blocking-send behavior instead of buffering
-    without bound. A wire error parks in ``error`` and is re-raised to
-    the next submit/flush (the writer thread has no caller to raise
-    into)."""
+    ``send_async`` enqueues frames here as ``(views, nbytes)`` pairs —
+    the scatter-gather view lists ``serialize_views`` built, drained by
+    vectored ``sendmsg`` writes through the shared per-destination
+    socket (under the same ``_out_locks[dst]`` the blocking path takes,
+    so async and sync frames never interleave mid-write). The views
+    alias the payload's own buffers until the write completes, which is
+    exactly the ``send_async`` contract (NetInterface: the caller must
+    not mutate a queued payload before ``flush_sends``). Backpressure:
+    ``submit`` blocks once ``-send_queue_mb`` of frame bytes — summed
+    view lengths — are queued, so a runaway producer degrades to the
+    blocking-send behavior instead of buffering without bound. A wire
+    error parks in ``error`` and is re-raised to the next submit/flush
+    (the writer thread has no caller to raise into)."""
 
     def __init__(self, net: "TcpNet", dst: int):
         self._net = net
@@ -165,7 +322,7 @@ class _PeerWriter:
             name=f"mv-tcp-write-r{net.rank}-d{dst}")
         self._thread.start()
 
-    def submit(self, frame: bytes) -> None:
+    def submit(self, views: List[memoryview], nbytes: int) -> None:
         cap = max(1, int(get_flag("send_queue_mb"))) << 20
         with self._cond:
             while (self._queued_bytes >= cap and self.error is None
@@ -180,8 +337,8 @@ class _PeerWriter:
                     f"is dead ({self.error})") from self.error
             if self._closed:
                 raise RuntimeError("TcpNet finalized")
-            self._frames.append(frame)
-            self._queued_bytes += len(frame)
+            self._frames.append((views, nbytes))
+            self._queued_bytes += nbytes
             self._cond.notify_all()
 
     def flush(self, timeout: Optional[float] = None) -> None:
@@ -223,17 +380,17 @@ class _PeerWriter:
                     self._cond.wait()
                 if not self._frames:  # closed and drained
                     return
-                frame = self._frames.popleft()
+                views, nbytes = self._frames.popleft()
                 self._writing = True
             try:
                 # Same lock order as the blocking path (lock, then
                 # lazy-connect, then pace, then write the whole frame).
                 with self._net._out_locks[self._dst]:
                     sock = self._net._connect(self._dst)
-                    self._net._pace(len(frame))
+                    self._net._pace(nbytes)
                     with monitor("tcp_send"):
-                        sock.sendall(frame)
-                self._net._count_sent(len(frame))
+                        _sendmsg_all(sock, views)
+                self._net._count_sent(nbytes)
             except BaseException as exc:  # noqa: BLE001 - the writer
                 # has no caller to raise into; ANY death (OSError,
                 # MemoryError, ...) must park in self.error and wake
@@ -253,8 +410,14 @@ class _PeerWriter:
                 if isinstance(exc, OSError) and not self._net._closed:
                     self._net._peer_connection_died(self._dst, exc)
                 return
+            # Drop the view list BEFORE parking in the next wait: the
+            # views alias payload buffers (possibly a pooled receive
+            # frame being forwarded), and an idle writer holding its
+            # last frame's views would pin that memory until the next
+            # send to this peer.
+            views = None
             with self._cond:
-                self._queued_bytes -= len(frame)
+                self._queued_bytes -= nbytes
                 self._writing = False
                 self._cond.notify_all()
 
@@ -286,6 +449,10 @@ class TcpNet(NetInterface):
         self._stats_lock = named_lock(f"tcp[r{rank}].stats")
         self._bytes_sent = 0
         self._wire_free_at = 0.0  # emulated-wire pacing deadline
+        # Receive-frame pool, shared by every reader thread of this
+        # endpoint (the leases are what recycle the buffers; the pool
+        # itself only caps what is RETAINED, so readers never block).
+        self._pool = BufferPool()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -322,17 +489,17 @@ class TcpNet(NetInterface):
         tid = trace_of(msg)
         with monitor("tcp_serialize"), \
                 tracing.span(tid, "tcp_serialize", self._rank):
-            frame = _serialize(msg)
+            views, nbytes = _frame_views(msg)
         try:
             with monitor("tcp_send"), \
                     tracing.span(tid, "tcp_send", self._rank,
                                  args={"dst": dst,
-                                       "bytes": len(frame)}
+                                       "bytes": nbytes}
                                  if tid else None):
                 with self._out_locks[dst]:
                     sock = self._connect(dst)
-                    self._pace(len(frame))
-                    sock.sendall(frame)
+                    self._pace(nbytes)
+                    _sendmsg_all(sock, views)
         except OSError as exc:
             # Broken connection mid-send: drop the cached socket (a
             # retry must reconnect, not re-use the corpse), report the
@@ -340,8 +507,8 @@ class TcpNet(NetInterface):
             self._peer_connection_died(dst, exc)
             raise PeerLostError(
                 f"send to rank {dst} failed: {exc}") from exc
-        self._count_sent(len(frame))
-        return len(frame)
+        self._count_sent(nbytes)
+        return nbytes
 
     def send_async(self, msg: Message) -> int:
         """Queue one serialized frame on the destination's writer thread
@@ -367,15 +534,15 @@ class TcpNet(NetInterface):
         tid = trace_of(msg)
         with monitor("tcp_serialize"), \
                 tracing.span(tid, "tcp_serialize", self._rank):
-            frame = _serialize(msg)
+            views, nbytes = _frame_views(msg)
         if tid:
             # The actual socket write happens on the writer thread,
             # which only sees bytes — the submit marker is the async
             # path's wire hop for sampled traces.
             tracing.event(tid, "tcp_send_async_submit", self._rank,
-                          args={"dst": dst, "bytes": len(frame)})
-        self._writer(dst).submit(frame)
-        return len(frame)
+                          args={"dst": dst, "bytes": nbytes})
+        self._writer(dst).submit(views, nbytes)
+        return nbytes
 
     def flush_sends(self, dst: Optional[int] = None,
                     timeout: Optional[float] = None) -> None:
@@ -581,6 +748,28 @@ class TcpNet(NetInterface):
             reader.start()
             self._readers.append(reader)
 
+    def _read_frame(self, conn: socket.socket,
+                    total: int) -> Optional[Message]:
+        """Read + parse one frame body. Zero-copy path: lease a pooled
+        buffer, ``recv_into`` it, and cut read-only Blob views straight
+        from the frame (the lease rides the Blobs and recycles the
+        buffer when the last one dies). ``-zero_copy=0`` restores the
+        legacy read-then-copy parse. None on EOF mid-frame."""
+        if bool(get_flag("zero_copy")):
+            lease = self._pool.lease(total)
+            with monitor("tcp_recv"):
+                if not _recv_into_exact(conn, lease.view(total)):
+                    lease.release()
+                    return None
+            with monitor("tcp_deserialize"):
+                return _deserialize_frame(lease.view(total), lease)
+        with monitor("tcp_recv"):
+            body = _read_exact(conn, total)
+        if body is None:
+            return None
+        with monitor("tcp_deserialize"):
+            return _deserialize(body)
+
     def _reader_main(self, conn: socket.socket) -> None:
         clean = False
         peer = None  # rank learned from the frames this conn carries
@@ -594,12 +783,9 @@ class TcpNet(NetInterface):
                     clean = True
                     return
                 t0_ns = tracing.now_ns()
-                with monitor("tcp_recv"):
-                    body = _read_exact(conn, total)
-                if body is None:
+                msg = self._read_frame(conn, total)
+                if msg is None:
                     return
-                with monitor("tcp_deserialize"):
-                    msg = _deserialize(body)
                 tid = trace_of(msg)
                 if tid:
                     # The trace id is only known after the parse; the

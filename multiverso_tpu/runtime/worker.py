@@ -564,8 +564,9 @@ class Worker(Actor):
             # read-your-writes floor rises with it).
             table.note_add_ack(server_id, version)
             if failed:
-                text = bytes(err_blobs[err_idx].as_array(np.uint8)) \
-                    .decode(errors="replace") \
+                # Error texts are blobs 1..k of the batch reply; the
+                # helper decodes straight off the wire view.
+                text = msg.text_payload(1 + err_idx) \
                     if err_idx < len(err_blobs) \
                     else "batched add failed on the server"
                 err_idx += 1

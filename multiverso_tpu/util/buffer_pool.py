@@ -1,0 +1,202 @@
+"""Size-classed receive-buffer pool with refcounted frame leases.
+
+TPU-native equivalent of the reference's pooled ``Allocator``
+(ref: include/multiverso/util/allocator.h:40-61, src/util/allocator.cpp):
+the reference hands ref-counted memory chunks to Blobs from a free list
+so the steady-state hot path never malloc/frees; here the transport's
+receive path leases a pooled ``bytearray`` per inbound frame, fills it
+with ``recv_into``, and the deserializer builds Blobs as ZERO-COPY numpy
+views into the leased buffer. The lease (one per frame) rides every Blob
+cut from the frame; when the last Blob dies, CPython refcounting fires
+``FrameLease.__del__`` and the buffer returns to the pool — the
+reference's ``MemoryBlock`` refcount collapsed onto the interpreter's.
+
+Safety over recycling (docs/MEMORY.md "Lease / ownership rules"):
+
+- a buffer is only re-pooled when NO buffer export is live on it. A
+  caller that extracted ``blob.as_array(...)`` and outlived the Blob
+  still holds a live export on the ``bytearray`` (numpy keeps the
+  buffer protocol export for the array's lifetime), and CPython refuses
+  to resize an exported ``bytearray`` — the pool probes with a
+  1-byte append/trim and, on ``BufferError``, parks the buffer on a
+  bounded pending list re-probed on later leases (or abandons it to GC
+  past the cap). A recycled frame can therefore never alias live data.
+- pool-backed views are READ-ONLY; mutation raises, and the few wire
+  consumers that legitimately need to write call ``Blob.materialize()``
+  (the copy-on-write contract).
+
+Capacity (``-buffer_pool_mb``) bounds what the pool RETAINS, never what
+it lends: ``lease`` always succeeds (allocating fresh on a miss), so the
+pool can never deadlock the reader threads; buffers returned above the
+cap are simply dropped to GC. Size classes are powers of two from 4 KB
+(``-buffer_pool_classes`` of them); oversized frames get an unpooled
+buffer with a no-op lease.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Optional
+
+from .configure import define_int, get_flag
+from .dashboard import count, samples
+from .lock_witness import named_lock
+
+define_int("buffer_pool_mb", 32,
+           "receive-buffer pool retained-capacity cap (MB): the "
+           "transport leases frame buffers here and recv_into fills "
+           "them in place, so steady-state receive traffic stops "
+           "allocating. Caps what the pool KEEPS between frames, never "
+           "what it lends (lease always succeeds); 0 disables pooling "
+           "(frames still deserialize as zero-copy views, into "
+           "GC-owned buffers)")
+define_int("buffer_pool_classes", 12,
+           "number of power-of-two buffer size classes, starting at "
+           "4 KB (12 classes = 4 KB .. 8 MB); frames above the largest "
+           "class ride unpooled GC-owned buffers")
+
+#: Smallest size class (bytes); class i holds buffers of _MIN_CLASS<<i.
+_MIN_CLASS = 4096
+
+#: Bound on buffers parked awaiting export release (a Blob's array
+#: outlived its lease): past this they are abandoned to GC instead.
+_PENDING_CAP = 64
+
+_pool_seq = itertools.count()
+
+
+class FrameLease:
+    """One leased frame buffer. Every Blob cut from the frame holds a
+    reference; the LAST holder's death returns the buffer to the pool
+    (``__del__`` → ``release``). ``release`` is idempotent; a lease
+    from a disabled/oversized allocation simply drops its buffer."""
+
+    __slots__ = ("_pool", "_buf")
+
+    def __init__(self, pool: Optional["BufferPool"], buf: bytearray):
+        self._pool = pool
+        self._buf = buf
+
+    def view(self, nbytes: int) -> memoryview:
+        """Writable view of the first ``nbytes`` (the recv_into target;
+        size-classed buffers are usually larger than the frame)."""
+        return memoryview(self._buf)[:nbytes]
+
+    @property
+    def nbytes(self) -> int:
+        return len(self._buf) if self._buf is not None else 0
+
+    def release(self) -> None:
+        buf, self._buf = self._buf, None
+        pool, self._pool = self._pool, None
+        if buf is not None and pool is not None:
+            pool._give_back(buf)
+
+    def __del__(self) -> None:
+        self.release()
+
+
+class BufferPool:
+    """Per-transport free list of receive buffers (see module doc)."""
+
+    def __init__(self, capacity_mb: Optional[int] = None,
+                 classes: Optional[int] = None):
+        cap = int(get_flag("buffer_pool_mb")) if capacity_mb is None \
+            else int(capacity_mb)
+        ncls = int(get_flag("buffer_pool_classes")) if classes is None \
+            else int(classes)
+        self._enabled = cap > 0 and ncls > 0
+        self._cap_bytes = max(cap, 0) << 20
+        self._classes = [_MIN_CLASS << i for i in range(max(ncls, 0))]
+        self._free = {size: collections.deque() for size in self._classes}
+        self._resident = 0
+        self._pending: collections.deque = collections.deque()
+        self._lock = named_lock(f"buffer_pool[{next(_pool_seq)}]")
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def resident_bytes(self) -> int:
+        """Bytes retained on the free lists right now."""
+        with self._lock:
+            return self._resident
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def lease(self, nbytes: int) -> FrameLease:
+        """A buffer of at least ``nbytes``. Never blocks, never fails:
+        a pool miss (or a disabled pool, or an oversized frame)
+        allocates fresh."""
+        if not self._enabled or nbytes > self._classes[-1]:
+            if self._enabled:
+                count("POOL_MISS")
+            # Unpooled: the lease owns nothing to return — plain GC.
+            return FrameLease(None, bytearray(max(nbytes, 1)))
+        size = self._class_for(nbytes)
+        buf = None
+        with self._lock:
+            self._reclaim_pending_locked()
+            dq = self._free[size]
+            if dq:
+                buf = dq.popleft()
+                self._resident -= size
+        if buf is None:
+            count("POOL_MISS")
+            buf = bytearray(size)
+        else:
+            count("POOL_HIT")
+        return FrameLease(self, buf)
+
+    def _class_for(self, nbytes: int) -> int:
+        for size in self._classes:
+            if nbytes <= size:
+                return size
+        return self._classes[-1]
+
+    @staticmethod
+    def _exports_released(buf: bytearray) -> bool:
+        """True when no live buffer export pins ``buf`` (a resize probe:
+        CPython refuses to resize an exported bytearray). The guard that
+        makes recycling safe against blob-outlives-frame callers."""
+        try:
+            buf.append(0)
+            del buf[-1]
+            return True
+        except BufferError:
+            return False
+
+    def _give_back(self, buf: bytearray) -> None:
+        with self._lock:
+            if not self._exports_released(buf):
+                # A view into the frame is still alive somewhere
+                # (e.g. a caller kept blob.as_array past the Blob):
+                # recycling now would alias live data. Park it for a
+                # later re-probe; past the cap, abandon to GC —
+                # correctness never depends on reclaiming.
+                if len(self._pending) < _PENDING_CAP:
+                    self._pending.append(buf)
+                return
+            self._store_locked(buf)
+
+    def _store_locked(self, buf: bytearray) -> None:
+        size = len(buf)
+        if size not in self._free \
+                or self._resident + size > self._cap_bytes:
+            return  # over capacity (or alien size): drop to GC
+        self._free[size].append(buf)
+        self._resident += size
+        samples("POOL_RESIDENT_KB").add(self._resident / 1024.0)
+
+    def _reclaim_pending_locked(self) -> None:
+        for _ in range(len(self._pending)):
+            buf = self._pending.popleft()
+            if self._exports_released(buf):
+                self._store_locked(buf)
+            else:
+                self._pending.append(buf)

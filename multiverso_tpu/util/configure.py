@@ -35,6 +35,11 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "rank": -1,
     "send_queue_mb": 32,
     "net_pace_mbps": 0.0,
+    # -- zero-copy wire path (runtime/tcp.py, util/buffer_pool.py;
+    #    docs/MEMORY.md) --
+    "zero_copy": True,
+    "buffer_pool_mb": 32,
+    "buffer_pool_classes": 12,
     "ps_role": "default",
     "ma": False,
     "sync": False,

@@ -53,6 +53,18 @@ METRIC_NAMES: Dict[str, str] = {
     "tcp_send": "blocking socket send of one frame",
     "tcp_recv": "socket read of one inbound frame body",
     "tcp_deserialize": "wire frame -> message parse",
+    # -- zero-copy wire path (runtime/tcp.py, util/buffer_pool.py;
+    #    docs/MEMORY.md) --
+    "WIRE_BYTES_COPIED": "payload+framing bytes memcpy'd by "
+                         "serialize/deserialize (the zero-copy "
+                         "bench signal)",
+    "WIRE_PAYLOAD_BYTES": "payload bytes that crossed "
+                          "serialize/deserialize (the copy-ratio "
+                          "denominator)",
+    "POOL_HIT": "receive-frame leases served from the buffer pool",
+    "POOL_MISS": "receive-frame leases that allocated fresh",
+    "POOL_RESIDENT_KB": "buffer-pool retained free bytes (KB) at "
+                        "each return",
     # -- client cache (tables/client_cache.py) --
     "CLIENT_CACHE_HIT": "cache lookups served locally",
     "CLIENT_CACHE_MISS": "cache lookups that crossed the wire",

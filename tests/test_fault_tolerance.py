@@ -526,13 +526,14 @@ def test_dead_peer_writer_wakes_senders_with_peer_lost():
     from multiverso_tpu.runtime.tcp import _PeerWriter
     net = _StubNet()
     writer = _PeerWriter(net, dst=1)
-    writer.submit(b"frame-1")  # accepted; the writer thread dies on it
+    # submit takes the frame as its (views, nbytes) scatter-gather pair
+    writer.submit([memoryview(b"frame-1")], 7)  # writer thread dies on it
     deadline = time.monotonic() + 5
     while writer.error is None and time.monotonic() < deadline:
         time.sleep(0.01)
     assert writer.error is not None
     with pytest.raises(PeerLostError, match="rank 1"):
-        writer.submit(b"frame-2")
+        writer.submit([memoryview(b"frame-2")], 7)
     with pytest.raises(PeerLostError):
         writer.flush()
     assert net.deaths and net.deaths[0][0] == 1

@@ -116,18 +116,40 @@ class TestFixtures:
         assert "did you mean 'max_get_staleness'" in messages
         assert "'port'" in messages
 
+    def test_copy_lint_seeded(self):
+        result = _fixture_result("bad_copies.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "copy-lint"]
+        assert len(found) == 3, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert ".tobytes() copies the whole payload" in messages
+        assert "bytes-join builds a flat frame copy" in messages
+        assert "bytes(...) copies its buffer" in messages
+        # memoryview/frombuffer view reads and no-arg bytes() stay
+        # silent; the pragma'd legacy-path site counts as suppressed.
+        assert result.per_pass_suppressed["copy-lint"] == 1
+
+    def test_copy_lint_out_of_scope_module_is_silent(self):
+        # The ban applies to the wire-path modules only: the same
+        # patterns in a fixture scanned under a non-wire rel path stay
+        # silent for every OTHER fixture (which all use bytes/joins
+        # freely in their own seeded content).
+        result = _fixture_result("bad_flags.py")
+        assert not [v for v in result.violations
+                    if v.pass_name == "copy-lint"]
+
     def test_fixture_dir_fails_as_a_whole(self):
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 24
-        assert len(result.suppressed) == 6
+        assert len(result.violations) == 27
+        assert len(result.suppressed) == 7
 
 
 class TestCleanTree:
     def test_final_tree_is_clean(self):
         # The acceptance gate: the shipped tree has zero non-pragma'd
-        # violations across all seven passes.
+        # violations across all eight passes.
         result = run(("multiverso_tpu", "tests", "bench.py"), REPO_ROOT)
         assert not result.failed, \
             "\n".join(v.render() for v in result.violations)
@@ -179,6 +201,27 @@ class TestCleanTree:
         assert "GHOST_METRIC" in messages          # doc-only row
         assert "NEVER_DOCUMENTED" in messages      # registry-only name
         assert len(found) == 2
+
+    def test_doc_wire_path_table_matches_lint(self):
+        from tools.mvlint.copy_lint import (WIRE_PATH_MODULES,
+                                            parse_doc_modules)
+        doc = parse_doc_modules(REPO_ROOT / "docs" / "MEMORY.md")
+        assert set(doc) == set(WIRE_PATH_MODULES)
+
+    def test_copy_lint_doc_drift_is_a_violation(self, tmp_path):
+        from tools.mvlint.copy_lint import CopyLint
+        drifted = tmp_path / "MEMORY.md"
+        drifted.write_text(
+            "| `multiverso_tpu/runtime/tcp.py` | wire-path | fine |\n"
+            "| `multiverso_tpu/ghost.py` | wire-path | stale row |\n")
+        lint = CopyLint(drifted)
+        module = ModuleInfo(FIXTURES / "bad_flags.py", REPO_ROOT)
+        found = list(lint.check(module))
+        messages = "\n".join(v.message for v in found)
+        assert "ghost.py" in messages                 # doc-only row
+        assert "core/blob.py" in messages             # missing row
+        # both directions fire: 1 stale + 6 missing modules
+        assert len(found) == 7
 
     def test_doc_drift_is_a_violation(self, tmp_path):
         drifted = tmp_path / "WIRE_FORMAT.md"

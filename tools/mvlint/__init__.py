@@ -1,6 +1,6 @@
 """mvlint: project-invariant static analysis for the actor/PS runtime.
 
-Seven passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+Eight passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 (see each module's docstring for the precise rules):
 
 * ``flag-lint`` — every flag access names a canonical registered flag
@@ -22,6 +22,10 @@ Seven passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
   flag and has a ``register_tunable_hook`` call site; every autotune
   policy's metric input names a canonical metric
   (``util/configure.py`` / ``runtime/autotune.py``; docs/AUTOTUNE.md).
+* ``copy-lint`` — ``.tobytes()`` / ``bytes(...)`` / ``b"".join`` are
+  banned on the zero-copy wire-path modules outside pragma-sanctioned
+  sites, and the module list is cross-checked against the table in
+  ``docs/MEMORY.md`` in both directions.
 
 Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
 (``--baseline`` prints per-pass counts without failing). The runtime
@@ -35,6 +39,7 @@ from __future__ import annotations
 from pathlib import Path
 from typing import List, Sequence
 
+from .copy_lint import CopyLint
 from .device_dispatch_lint import DeviceDispatchLint
 from .flag_lint import FlagLint, load_canonical_flags
 from .framework import LintPass, RunResult, Violation, run_passes
@@ -76,6 +81,7 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         SendDisciplineLint(),
         TunableLint(tunables, canonical, metrics, policies,
                     hook_sites),
+        CopyLint(root / "docs" / "MEMORY.md"),
     ]
 
 

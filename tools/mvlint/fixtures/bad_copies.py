@@ -1,0 +1,26 @@
+"""Seeded copy-lint violations (tools/mvlint/copy_lint.py).
+
+Each banned wire-path copy pattern appears once; the pragma'd site must
+count as suppressed, and the view-reading idioms must stay silent.
+"""
+
+import numpy as np
+
+
+def frame_the_slow_way(arr, chunks):
+    payload = arr.tobytes()                 # violation: tobytes copy
+    body = b"".join(chunks)                 # violation: flat-frame join
+    head = bytes(memoryview(body)[:8])      # violation: bytes() copy
+    return head, payload
+
+
+def sanctioned(arr):
+    # A deliberate legacy-path copy keeps the annotated escape hatch.
+    return arr.tobytes()  # mvlint: ignore[copy-lint]
+
+
+def stays_silent(arr, parts):
+    views = [memoryview(p) for p in parts]  # view list: fine
+    flat = np.frombuffer(parts[0], np.uint8)  # zero-copy wrap: fine
+    empty = bytes()                         # no-arg: copies nothing
+    return views, flat, empty
