@@ -9,13 +9,22 @@ echo "== mvlint static-analysis gate =="
 # Project invariants, machine-checked before anything runs: flag
 # registry, wire-slot registry (cross-checked vs docs/WIRE_FORMAT.md),
 # device-dispatch guarding, lock discipline, copy discipline on the
-# zero-copy wire path (cross-checked vs docs/MEMORY.md). Fails on any
-# non-pragma'd
+# zero-copy wire path (cross-checked vs docs/MEMORY.md),
+# interprocedural thread-role blocking reachability (cross-checked vs
+# docs/THREADS.md + the THREAD_ROLES registry; runtime twin is the
+# -debug_locks/-role_block_budget_ms watchdog) and guarded-by
+# field/lock annotations — ten passes total. Fails on any non-pragma'd
 # violation and prints file:line diagnostics; the trailing summary
 # shows per-pass counts. (`python -m tools.mvlint --baseline ...`
 # prints the same counts WITHOUT failing — drift-at-a-glance for PRs.)
 # See docs/STATIC_ANALYSIS.md.
 python -m tools.mvlint multiverso_tpu tests bench.py
+
+# Stale-suppression review line, NOT a gate: pragmas that suppressed
+# zero findings are listed for cleanup but never fail the build (a
+# pragma can be load-bearing only on certain trees).
+python -m tools.mvlint --report-unused-pragmas \
+    multiverso_tpu tests bench.py | grep '^warning:' || true
 
 echo "== mvlint self-check (seeded fixtures must still fail) =="
 # The analyzers are regression-protected: a pass that silently stops
@@ -112,6 +121,17 @@ echo "== autotune subset (dynamic flags / config broadcast / policies) =="
 # docs/AUTOTUNE.md). The static half of the gate — tunable-lint —
 # already ran in the mvlint block above.
 python -m pytest tests/test_autotune.py -x -q -m 'not slow'
+
+echo "== roles subset (thread-role registry / blocking watchdog / call graph) =="
+# The thread-role layer gets its own named gate: the spawn contract
+# (role registry, auto-start, live-registry drain), the -debug_locks
+# blocking watchdog (fires on a deliberately-parked DISPATCH thread,
+# silent on a clean 2-rank PS smoke), and the interprocedural call
+# graph passes 9/10 stand on (method resolution under a subclass
+# binding, Thread-target edges, functools.partial, recursion/depth
+# bounds). The static half — thread-role + guarded-by — already ran
+# in the mvlint block above. docs/THREADS.md.
+python -m pytest tests/test_thread_roles.py tests/test_callgraph.py -x -q
 
 echo "== obs subset (tracing / metrics export / scrape surface) =="
 # Observability invariants get their own named gate: trace-id sampling

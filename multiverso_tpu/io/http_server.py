@@ -99,10 +99,12 @@ class HttpServer:
         self._resolve = resolve
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
+        # Local import: io must not pull the runtime package (and its
+        # actor/zoo import chain) at module load.
+        from ..runtime import thread_roles
+        self._thread = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=self._httpd.serve_forever,
             name=f"mv-{name}-{self.port}")
-        self._thread.start()
         log.info("%s: serving on port %d", self._name, self.port)
 
     # -- request plumbing --

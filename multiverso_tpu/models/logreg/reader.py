@@ -21,13 +21,13 @@ while the current one trains (the reference's async reader + the
 from __future__ import annotations
 
 import struct
-import threading
 import queue as queue_mod
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ...io import StreamFactory, TextReader
+from ...runtime import thread_roles
 from ...updater.engine import bucket_size
 from .config import Configure
 
@@ -159,8 +159,9 @@ class PrefetchReader:
             queue_mod.Queue(maxsize=depth)
         self._config = config
         self._path = path
-        self._thread = threading.Thread(target=self._fill, daemon=True)
-        self._thread.start()
+        self._thread = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=self._fill,
+            name="mv-logreg-prefetch")
 
     def _fill(self) -> None:
         try:

@@ -16,12 +16,12 @@ exploded pairs (ref trains both modes, wordembedding.cpp).
 from __future__ import annotations
 
 import queue as queue_mod
-import threading
 from typing import Iterator, List, Optional
 
 import numpy as np
 
 from ...io import TextReader
+from ...runtime import thread_roles
 from .dictionary import Dictionary
 
 MAX_SENTENCE_LEN = 1000  # ref: constant MAX_SENTENCE_LENGTH
@@ -288,9 +288,9 @@ class BlockLoader:
 
     def __init__(self, batch_iter: Iterator, depth: int = 8):
         self._queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
-        self._thread = threading.Thread(
-            target=self._fill, args=(batch_iter,), daemon=True)
-        self._thread.start()
+        self._thread = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=self._fill,
+            args=(batch_iter,), name="mv-we-blockloader")
 
     def _fill(self, batch_iter) -> None:
         try:

@@ -33,6 +33,7 @@ try:  # jax >= 0.4.31 exports it at the top level
 except ImportError:  # older jax: the experimental module is the API
     from jax.experimental.shard_map import shard_map
 
+from ..runtime import thread_roles
 from ..runtime.zoo import current_zoo
 from ..sharding import mesh as meshlib
 from ..util.dashboard import monitor
@@ -121,8 +122,8 @@ def model_average_async(data: np.ndarray, zoo=None, *,
             future._set_error(exc)
 
     try:
-        threading.Thread(target=run, daemon=True,
-                         name=f"mv-ma-avg-r{zoo.net.rank}").start()
+        thread_roles.spawn(thread_roles.BACKGROUND, target=run,
+                           name=f"mv-ma-avg-r{zoo.net.rank}")
     except BaseException:
         # The reserved slot must not leak: an unserved ticket would
         # block every later collective on this endpoint forever. Serve
@@ -222,8 +223,8 @@ def sharded_model_average_async(data: np.ndarray, zoo=None, *,
             future._set_error(exc)
 
     try:
-        threading.Thread(target=run, daemon=True,
-                         name=f"mv-ma-shavg-r{zoo.net.rank}").start()
+        thread_roles.spawn(thread_roles.BACKGROUND, target=run,
+                           name=f"mv-ma-shavg-r{zoo.net.rank}")
     except BaseException:
         # Serve the reserved ticket as a no-op before re-raising, or
         # every later collective on this endpoint blocks forever.

@@ -17,6 +17,7 @@ from typing import Callable, Dict, Optional
 from ..core.message import Message
 from ..util import log
 from ..util.mt_queue import MtQueue
+from . import thread_roles
 
 # ref: include/multiverso/actor.h:60-67
 WORKER = "worker"
@@ -26,6 +27,11 @@ COMMUNICATOR = "communicator"
 
 
 class Actor:
+    #: Thread role the run loop registers at spawn (docs/THREADS.md).
+    #: Subclasses override — the Communicator's loop is DISPATCH: it
+    #: must never block (mvlint pass 9 proves it can't).
+    ROLE = thread_roles.ACTOR
+
     def __init__(self, name: str, zoo) -> None:
         self.name = name
         self._zoo = zoo
@@ -36,10 +42,9 @@ class Actor:
 
     # -- lifecycle --
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._main, name=f"mv-{self.name}-r{self._zoo.rank}",
-            daemon=True)
-        self._thread.start()
+        self._thread = thread_roles.spawn(
+            self.ROLE, target=self._main,
+            name=f"mv-{self.name}-r{self._zoo.rank}")
 
     def stop(self) -> None:
         """Drain-exit: the thread finishes the current message then stops."""

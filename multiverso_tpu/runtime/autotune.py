@@ -46,6 +46,7 @@ from ..util.configure import (CANONICAL_FLAGS, define_double,
 from ..util.dashboard import count
 from ..util.lock_witness import named_condition, named_lock
 from . import actor as actors
+from . import thread_roles
 
 define_double("autotune_interval_s", 0.0,
               "closed-loop self-tuning cadence ON THE CONTROLLER RANK "
@@ -179,26 +180,28 @@ class AutotuneManager:
         # a fresh manager (bench re-init) must outrank the previous
         # run's broadcasts or its first update would be ignored as a
         # replay.
-        self._epoch = configure.applied_config_epoch()
+        self._epoch = configure.applied_config_epoch()  # guarded_by: _state_lock
         #: Cumulative knob map (every change ever broadcast): each
         #: broadcast carries the FULL map so a rank that missed an
         #: epoch converges from any later one, and a rejoined rank
         #: re-anchors from a single re-broadcast.
-        self._config: Dict[str, Any] = {}
+        self._config: Dict[str, Any] = {}  # guarded_by: _state_lock
+        # _tick/_streak/_last_change/_prev_counts are tick-thread-only
+        # working state (tick_once callers serialize); not annotated.
         self._tick = 0
         self._streak: Dict[str, Tuple[str, int]] = {}
         self._last_change: Dict[str, int] = {}
-        self._gauges: Dict[str, Dict] = {}
-        self._acked: Dict[int, int] = {}
-        self._trajectory: collections.deque = collections.deque(
+        self._gauges: Dict[str, Dict] = {}  # guarded_by: _state_lock
+        self._acked: Dict[int, int] = {}  # guarded_by: _state_lock
+        self._trajectory: collections.deque = collections.deque(  # guarded_by: _state_lock
             maxlen=TRAJECTORY_CAP)
         # Monotonic decision count for the exported counter — the
         # trajectory deque is capped, so its len() would freeze.
-        self._decisions_total = 0
+        self._decisions_total = 0  # guarded_by: _state_lock
         # Previous cumulative monitor totals, for per-tick deltas.
         self._prev_counts: Dict[str, Tuple[int, float]] = {}
         self._stop_cond = named_condition(f"autotune[r{zoo.rank}].stop")
-        self._stopped = False
+        self._stopped = False  # guarded_by: _stop_cond
         self._thread: Optional[threading.Thread] = None
         self._policies = {
             "max_get_staleness": self._policy_staleness,
@@ -214,10 +217,9 @@ class AutotuneManager:
         interval = float(get_flag("autotune_interval_s"))
         if interval <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(
-            target=self._main, args=(interval,), daemon=True,
-            name=f"mv-autotune-r{self._zoo.rank}")
-        self._thread.start()
+        self._thread = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=self._main,
+            args=(interval,), name=f"mv-autotune-r{self._zoo.rank}")
 
     def stop(self) -> None:
         with self._stop_cond:

@@ -12,10 +12,10 @@ one Zoo per host over the DCN transport instead.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, List, Optional
 
 from . import device_lock
+from . import thread_roles
 from .net import LocalFabric
 from .zoo import ClusterAborted, Zoo, set_thread_zoo
 
@@ -85,11 +85,10 @@ class LocalCluster:
                 finally:
                     set_thread_zoo(None)
 
-        threads = [threading.Thread(target=rank_main, args=(r,),
-                                    name=f"mv-rank-{r}", daemon=True)
+        threads = [thread_roles.spawn(thread_roles.BACKGROUND,
+                                      target=rank_main, args=(r,),
+                                      name=f"mv-rank-{r}")
                    for r in range(self.n)]
-        for t in threads:
-            t.start()
         hung = []
         for t in threads:
             t.join(timeout=self.timeout)

@@ -33,6 +33,7 @@ from ..util.mt_queue import MtQueue
 from ..util.wire_codec import (CAP_WIRE_CODEC, decode_message,
                                encode_message)
 from . import actor as actors
+from . import thread_roles
 from .actor import Actor
 
 define_bool("dispatch_queues", True,
@@ -63,8 +64,12 @@ class _DispatchQueues:
 
     def __init__(self, comm: "Communicator"):
         self._comm = comm
+        # _queues is NOT guarded_by-annotated: submit()'s lock-free
+        # first probe (double-checked creation) reads it off-lock on
+        # purpose — a GIL-atomic dict.get whose miss re-checks under
+        # the lock.
         self._queues: dict = {}
-        self._threads: list = []
+        self._threads: list = []  # guarded_by: _lock
         self._lock = named_lock(  # lazy per-dst creation
             f"communicator.dispatchq[r{comm._zoo.rank}]")
         # Byte-bounded, like TcpNet's async writer queues one layer
@@ -75,7 +80,7 @@ class _DispatchQueues:
         # communicator actor while a destination is over budget —
         # under overload only, which is exactly the old behavior.
         self._cap_bytes = max(int(get_flag("send_queue_mb", 32)), 1) << 20
-        self._queued_bytes: dict = {}
+        self._queued_bytes: dict = {}  # guarded_by: _drained
         self._drained = named_condition(
             f"communicator.dispatchq[r{comm._zoo.rank}].drained",
             self._lock)
@@ -93,12 +98,14 @@ class _DispatchQueues:
                 if queue is None:
                     queue = MtQueue(
                         f"dispatchq[r{self._comm._zoo.rank}->d{dst}]")
-                    thread = threading.Thread(
-                        target=self._main, args=(dst, queue), daemon=True,
+                    # WRITER role: blocking on the wire toward one
+                    # destination is this thread's whole purpose.
+                    thread = thread_roles.spawn(
+                        thread_roles.WRITER,
+                        target=self._main, args=(dst, queue),
                         name=f"mv-dispatch-r{self._comm._zoo.rank}-d{dst}")
                     self._queues[dst] = queue
                     self._threads.append(thread)
-                    thread.start()
         nbytes = self._nbytes(msg)
         with self._drained:
             # Block until the destination is under budget — the same
@@ -162,7 +169,11 @@ class _DispatchQueues:
         buffered items after exit), then the threads finish."""
         for queue in list(self._queues.values()):
             queue.exit()
-        for thread in self._threads:
+        # Snapshot under the lock: a submit() racing shutdown could
+        # append a writer while this loop iterates the list.
+        with self._lock:
+            threads = list(self._threads)
+        for thread in threads:
             thread.join(timeout=30)
 
     def depths(self) -> dict:
@@ -170,6 +181,11 @@ class _DispatchQueues:
 
 
 class Communicator(Actor):
+    #: The dispatch loop is latency-critical: every control/liveness
+    #: frame in the process rides it. mvlint pass 9 proves no blocking
+    #: primitive is reachable from it.
+    ROLE = thread_roles.DISPATCH
+
     def __init__(self, zoo) -> None:
         super().__init__(actors.COMMUNICATOR, zoo)
         # Outbound pressure observable next to the server/worker
@@ -198,10 +214,11 @@ class Communicator(Actor):
     def start(self) -> None:
         super().start()
         self._net.acquire_recv_owner()
-        self._recv_thread = threading.Thread(
-            target=self._recv_main,
-            name=f"mv-comm-recv-r{self._zoo.rank}", daemon=True)
-        self._recv_thread.start()
+        # DISPATCH too: the recv thread routes inbound frames into
+        # actor mailboxes — anything blocking it starves replies.
+        self._recv_thread = thread_roles.spawn(
+            thread_roles.DISPATCH, target=self._recv_main,
+            name=f"mv-comm-recv-r{self._zoo.rank}")
 
     def stop(self, finalize_net: bool = True) -> None:
         # Drain-exit the actor thread BEFORE closing the transport: replies
@@ -234,11 +251,14 @@ class Communicator(Actor):
     # frames (mixed-version clusters stay correct, merely uncompressed).
     def _dispatch(self, msg: Message) -> None:
         if msg.dst != self._zoo.rank:
-            if self._queues is not None \
-                    and is_server_bound(msg.type_int):
-                # Server-bound traffic rides the destination's own
-                # queue thread: encode + send for a slow peer must not
-                # block this thread's traffic to its siblings.
+            if self._queues is not None:
+                # ALL remote traffic rides the destination's own
+                # queue thread (WRITER role), not just server-bound
+                # requests: a reply or control frame doing a blocking
+                # wire send from THIS thread would starve every frame
+                # behind it — the PR-6/9/12 class pass 9 now proves
+                # away. Per-destination FIFO still holds: everything
+                # toward one dst flows through one queue.
                 self._queues.submit(msg)
                 return
             self._encode_and_send(msg)
@@ -282,7 +302,12 @@ class Communicator(Actor):
                 self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
             encode_message(msg)
         try:
-            self._net.send(msg)
+            # Reached from the DISPATCH loop only when the transport is
+            # in-process (send = mailbox push, non-blocking) or when
+            # -dispatch_queues is explicitly off — the documented
+            # legacy direct-backpressure mode; wire deployments route
+            # through the WRITER queue threads above.
+            self._net.send(msg)  # mvlint: ignore[thread-role]
         except Exception as exc:  # noqa: BLE001 - a dead peer must
             # not strand the requester's waiter (the actor loop
             # would only log): synthesize the error reply the peer

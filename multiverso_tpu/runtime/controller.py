@@ -50,6 +50,7 @@ from ..util import log
 from ..util.configure import define_double, get_flag
 from ..util.lock_witness import named_condition, named_lock
 from . import actor as actors
+from . import thread_roles
 from .actor import Actor
 from .net import PeerLostError
 
@@ -490,16 +491,15 @@ class HeartbeatMonitor:
                             self._interval * 2)
         self._stop_cond = named_condition(
             f"heartbeat[r{zoo.rank}].stop")
-        self._stopped = False
+        self._stopped = False  # guarded_by: _stop_cond
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
         if self._interval <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(
-            target=self._main, daemon=True,
+        self._thread = thread_roles.spawn(
+            thread_roles.LIVENESS, target=self._main,
             name=f"mv-heartbeat-r{self._zoo.rank}")
-        self._thread.start()
 
     def stop(self) -> None:
         with self._stop_cond:

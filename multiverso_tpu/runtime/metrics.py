@@ -39,6 +39,7 @@ from ..util.configure import define_double, define_int, get_flag
 from ..util.dashboard import (METRICS_SNAPSHOT_VERSION, Samples, count,
                               metrics_snapshot)
 from ..util.lock_witness import named_condition, named_lock
+from . import thread_roles
 
 define_double("metrics_interval_s", 0.0,
               "ship this rank's Dashboard/Samples snapshot (+ new "
@@ -66,14 +67,14 @@ class MetricsReporter:
         self._interval = float(get_flag("metrics_interval_s"))
         self._stop_cond = named_condition(
             f"metrics_reporter[r{zoo.rank}].stop")
-        self._stopped = False
+        self._stopped = False  # guarded_by: _stop_cond
         self._thread: Optional[threading.Thread] = None
         # flush() runs on app threads while the reporter thread ticks:
         # serializing reports keeps _sent_seq consistent (a racing pair
         # would ship the same trace events twice).
         self._report_lock = named_lock(
             f"metrics_reporter[r{zoo.rank}].report")
-        self._sent_seq = 0
+        self._sent_seq = 0  # guarded_by: _report_lock
         # Report ordering guard: every report carries this reporter
         # INCARNATION (unique per reporter lifetime — a restarted/
         # rejoined rank gets a fresh one) plus a monotonic sequence,
@@ -82,15 +83,14 @@ class MetricsReporter:
         # (ClusterMetrics.ingest).
         self._incarnation = f"{os.getpid():x}-{id(self):x}-" \
                             f"{time.time_ns():x}"
-        self._report_seq = 0
+        self._report_seq = 0  # guarded_by: _report_lock
 
     def start(self) -> None:
         if self._interval <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(
-            target=self._main, daemon=True,
+        self._thread = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=self._main,
             name=f"mv-metrics-r{self._zoo.rank}")
-        self._thread.start()
 
     def stop(self) -> None:
         with self._stop_cond:
@@ -199,8 +199,9 @@ class ClusterMetrics:
 
     def __init__(self) -> None:
         self._lock = named_lock("cluster_metrics")
-        self._ranks: Dict[int, Dict] = {}  # rank -> latest snapshot
-        self._trace: collections.deque = collections.deque(
+        # rank -> latest snapshot
+        self._ranks: Dict[int, Dict] = {}  # guarded_by: _lock
+        self._trace: collections.deque = collections.deque(  # guarded_by: _lock
             maxlen=MERGED_TRACE_CAP)
         # Per-rank report-ordering watermark: (incarnation, seq) of
         # the newest report folded in. A report whose seq does not
@@ -213,12 +214,12 @@ class ClusterMetrics:
         # de-parked pre-crash frame and is dropped: folding it would
         # roll the rank's view back to the dead process AND reset the
         # watermark under it.
-        self._report_mark: Dict[int, Tuple[str, int]] = {}
+        self._report_mark: Dict[int, Tuple[str, int]] = {}  # guarded_by: _lock
         # Ordered (dict-as-ordered-set): the cap must evict the OLDEST
         # superseded incarnation, never the most recent predecessor —
         # whose de-parked frames are exactly the ones to drop.
-        self._prior_incs: Dict[int, Dict[str, None]] = {}
-        self.dropped_stale = 0
+        self._prior_incs: Dict[int, Dict[str, None]] = {}  # guarded_by: _lock
+        self.dropped_stale = 0  # guarded_by: _lock
 
     #: Superseded incarnations remembered per rank (a de-parked frame
     #: can only be from a recent predecessor; a tiny cap bounds a
@@ -272,6 +273,9 @@ class ClusterMetrics:
                          "samples": {n: dict(v)
                                      for n, v in s["samples"].items()}}
                      for r, s in self._ranks.items()}
+            # Captured WITH the snapshots: ingest increments it
+            # concurrently, and the view should be one consistent cut.
+            dropped = self.dropped_stale
         monitors_sum: Dict[str, Dict] = {}
         windows: Dict[str, List[float]] = {}
         counts: Dict[str, int] = {}
@@ -301,7 +305,7 @@ class ClusterMetrics:
         return {"v": METRICS_SNAPSHOT_VERSION, "ranks": ranks,
                 "monitors_sum": monitors_sum,
                 "samples_merged": samples_merged,
-                "dropped_reports": self.dropped_stale}
+                "dropped_reports": dropped}
 
     # -- scrape renderings --
     def prometheus_text(self) -> str:

@@ -25,6 +25,13 @@ Protocol (full spec in docs/SHARDING.md):
   serving a replica hit is a numpy gather, no device program and no
   device lock, which is what makes scale-out win on read-heavy
   traffic;
+Concurrency note (mvlint pass 10): this module carries NO
+``guarded_by`` annotations on purpose — every mutable structure here
+is confined to exactly one actor thread (tracker + store on the server
+actor, router map on the worker actor, aggregator on the controller
+actor; per-class notes below), so there is no lock to annotate
+against.
+
 * workers route the replicated subset of a row Get to holders
   (``ReplicaRouter``): a worker co-located with a server prefers its
   LOCAL shard, a pure worker stripes per-row across all servers —

@@ -24,6 +24,13 @@ three coordinated pieces (full protocol spec in docs/SHARDING.md,
   (single actor thread — no lock needed). The destination detects
   chunk loss by seq gap at the final chunk and requests retransmits;
   only a complete range commits.
+Concurrency note (mvlint pass 10): this module carries NO
+``guarded_by`` annotations on purpose — the map and both migration
+state machines are confined to their owning actor thread (map applies
+on the worker/server actor, migrations run on the server actor,
+planning on the controller actor), so the discipline here is
+single-thread confinement, not locking.
+
 * :class:`ReshardManager` — the controller-side coordinator: plans a
   minimal move list toward an even spread over the requested active
   servers (or, with ``-reshard_auto``, splits skewed ranges from the

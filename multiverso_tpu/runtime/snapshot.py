@@ -44,6 +44,7 @@ from ..util import log
 from ..util.configure import define_double, define_string, get_flag
 from ..util.dashboard import monitor
 from ..util.lock_witness import named_condition
+from . import thread_roles
 
 define_double("snapshot_interval_s", 0.0,
               "period of the per-server background snapshotter: every "
@@ -102,7 +103,7 @@ class SnapshotManager:
         self.tables_restored = 0
         self._stop_cond = named_condition(
             f"snapshot[r{zoo.rank}].stop")
-        self._stopped = False
+        self._stopped = False  # guarded_by: _stop_cond
         self._thread: Optional[threading.Thread] = None
         self._restored_ids: set = set()
         #: Tables open to the snapshotter: a shard is tracked at
@@ -219,10 +220,9 @@ class SnapshotManager:
     def start(self) -> None:
         if self._interval <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(
-            target=self._main, daemon=True,
+        self._thread = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=self._main,
             name=f"mv-snapshot-r{self._zoo.rank}")
-        self._thread.start()
 
     def stop(self) -> None:
         with self._stop_cond:

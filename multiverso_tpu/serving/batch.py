@@ -123,22 +123,22 @@ class BatchedTableReader:
         self._lock = named_lock(f"serving.batch[{serial}]")
         self._cond = named_condition(f"serving.batch[{serial}].arrive",
                                      self._lock)
-        self._pending: List[_PendingRead] = []
+        self._pending: List[_PendingRead] = []  # guarded_by: _lock
         #: MERGED unique rows of the open batch (the documented
         #: -serving_batch_max_rows unit): counting the per-request sum
         #: would flush early exactly in the high-overlap regime where
         #: folding pays most.
-        self._pending_row_set: set = set()
-        self._open_t = 0.0
-        self._stopping = False
+        self._pending_row_set: set = set()  # guarded_by: _lock
+        self._open_t = 0.0  # guarded_by: _lock
+        self._stopping = False  # guarded_by: _lock
         self.batches = 0      # observability (tests/bench)
         self.requests = 0
         self._thread = None
         if self._window > 0:
-            self._thread = threading.Thread(
-                target=self._run, daemon=True,
+            from ..runtime import thread_roles
+            self._thread = thread_roles.spawn(
+                thread_roles.BACKGROUND, target=self._run,
                 name=f"mv-serving-batch-{name}")
-            self._thread.start()
         # Live retuning (docs/AUTOTUNE.md): the batcher thread reads
         # _window/_max_rows fresh per batch, so rebinding them is
         # picked up on the next window (a live window change cannot

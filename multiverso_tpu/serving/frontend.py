@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..runtime import thread_roles
 from ..io.http_server import (HttpError, HttpServer, Response,
                               json_response)
 from ..util import log
@@ -130,10 +131,10 @@ class ServingFrontend(HttpServer):
         self._fleet_thread: Optional[threading.Thread] = None
         interval = float(get_flag("serving_fleet_interval_s", 2.0))
         if interval > 0:
-            self._fleet_thread = threading.Thread(
-                target=self._fleet_main, args=(interval,),
-                daemon=True, name=f"mv-serving-fleet-{self.port}")
-            self._fleet_thread.start()
+            self._fleet_thread = thread_roles.spawn(
+                thread_roles.BACKGROUND, target=self._fleet_main,
+                args=(interval,),
+                name=f"mv-serving-fleet-{self.port}")
 
     # -- registry --
     def register_table(self, name: str, table,

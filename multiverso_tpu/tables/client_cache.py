@@ -122,9 +122,9 @@ class VersionTracker:
     observation). Fed by the worker actor from reply version stamps."""
 
     def __init__(self) -> None:
-        self._latest: Dict[int, int] = {}
         self._lock = named_lock(
             f"client_cache.VersionTracker[{next(_lock_serial)}]")
+        self._latest: Dict[int, int] = {}  # guarded_by: _lock
 
     def note(self, server_id: int, version: int) -> None:
         if version < 0:
@@ -134,7 +134,12 @@ class VersionTracker:
                 self._latest[server_id] = version
 
     def latest(self, server_id: int) -> int:
-        return self._latest.get(server_id, -1)
+        # Under the lock like every other reader: a torn read is not
+        # possible for one dict probe, but the freshness math in
+        # RowCache._fresh must not see a version OLDER than one a
+        # concurrent note() already published to another field.
+        with self._lock:
+            return self._latest.get(server_id, -1)
 
     def regressed(self, server_id: int, version: int) -> bool:
         """True when a stamped reply carries a LOWER version than the
@@ -171,19 +176,27 @@ class RowCache:
         self._server_of = server_of  # vectorized row ids -> server ids
         self._num_servers = int(num_servers)
         self._tracker = tracker
-        self._capacity = int(capacity if capacity is not None
+        self._capacity = int(capacity if capacity is not None  # guarded_by: _lock
                              else get_flag("client_cache_rows"))
         self._lock = named_lock(
             f"client_cache.RowCache[{next(_lock_serial)}]")
-        self._rows: Dict[int, Tuple[int, np.ndarray]] = {}
-        self._floor: Dict[int, int] = {}      # per-row min fetch version
-        self._floor_all: Dict[int, int] = {}  # per-server floor
-        self._pending: Dict[int, int] = {}    # row -> outstanding own-adds
-        self._pending_all = 0                 # whole-table own-adds
-        self.hits = 0        # full-local Gets (no wire message at all)
-        self.misses = 0      # Gets that needed the wire for >=1 row
-        self.rows_hit = 0    # row-granular accounting across both
-        self.rows_missed = 0
+        # _bound stays unannotated by choice: the hot read path probes
+        # it lock-free (one int, GIL-atomic) and _retune_bound rebinds
+        # it under the lock — a stale read is one Get at the old bound.
+        self._rows: Dict[int, Tuple[int, np.ndarray]] = {}  # guarded_by: _lock
+        # _floor: per-row min fetch version; _floor_all: per-server
+        # floor; _pending: row -> outstanding own-adds; _pending_all:
+        # whole-table own-adds.
+        self._floor: Dict[int, int] = {}      # guarded_by: _lock
+        self._floor_all: Dict[int, int] = {}  # guarded_by: _lock
+        self._pending: Dict[int, int] = {}    # guarded_by: _lock
+        self._pending_all = 0                 # guarded_by: _lock
+        # hits/misses: whole-Get accounting (full-local vs needed the
+        # wire); rows_hit/rows_missed: row-granular across both.
+        self.hits = 0        # guarded_by: _lock
+        self.misses = 0      # guarded_by: _lock
+        self.rows_hit = 0    # guarded_by: _lock
+        self.rows_missed = 0  # guarded_by: _lock
         #: test hook: fn(row, entry_version, latest_observed, bound),
         #: called under the cache lock for every row actually SERVED.
         self.on_hit = None
@@ -452,15 +465,22 @@ class RowCache:
 
     @property
     def stats(self) -> Dict[str, float]:
-        total = self.hits + self.misses
-        rows_total = self.rows_hit + self.rows_missed
-        return {"hits": self.hits, "misses": self.misses,
-                "hit_rate": self.hits / total if total else 0.0,
-                "rows_hit": self.rows_hit,
-                "rows_missed": self.rows_missed,
-                "row_hit_rate": self.rows_hit / rows_total
+        # One consistent cut under the lock: the counters move together
+        # in fetch_into, and a rate computed from a half-updated pair
+        # can exceed 1.0.
+        with self._lock:
+            hits, misses = self.hits, self.misses
+            rows_hit, rows_missed = self.rows_hit, self.rows_missed
+            nrows = len(self._rows)
+        total = hits + misses
+        rows_total = rows_hit + rows_missed
+        return {"hits": hits, "misses": misses,
+                "hit_rate": hits / total if total else 0.0,
+                "rows_hit": rows_hit,
+                "rows_missed": rows_missed,
+                "row_hit_rate": rows_hit / rows_total
                 if rows_total else 0.0,
-                "rows": len(self._rows)}
+                "rows": nrows}
 
 
 class BlobCache:
@@ -474,11 +494,11 @@ class BlobCache:
         self._tracker = tracker
         self._lock = named_lock(
             f"client_cache.BlobCache[{next(_lock_serial)}]")
-        self._shards: Dict[int, Tuple[int, np.ndarray]] = {}
-        self._floor: Dict[int, int] = {}
-        self._pending = 0
-        self.hits = 0
-        self.misses = 0
+        self._shards: Dict[int, Tuple[int, np.ndarray]] = {}  # guarded_by: _lock
+        self._floor: Dict[int, int] = {}  # guarded_by: _lock
+        self._pending = 0  # guarded_by: _lock
+        self.hits = 0  # guarded_by: _lock
+        self.misses = 0  # guarded_by: _lock
         self.on_hit = None  # fn(server_id, entry_version, latest, bound)
 
     def fresh_all(self) -> bool:
@@ -573,11 +593,11 @@ class SnapshotCache:
         self._capacity = int(capacity)
         self._lock = named_lock(
             f"client_cache.SnapshotCache[{next(_lock_serial)}]")
-        self._entries: Dict[bytes, Tuple[Dict[int, int], dict]] = {}
-        self._floor: Dict[int, int] = {}
-        self._pending = 0
-        self.hits = 0
-        self.misses = 0
+        self._entries: Dict[bytes, Tuple[Dict[int, int], dict]] = {}  # guarded_by: _lock
+        self._floor: Dict[int, int] = {}  # guarded_by: _lock
+        self._pending = 0  # guarded_by: _lock
+        self.hits = 0  # guarded_by: _lock
+        self.misses = 0  # guarded_by: _lock
 
     def fetch(self, key: bytes, server_ids) -> Optional[dict]:
         with self._lock:

@@ -34,8 +34,12 @@ class ASyncBuffer(Generic[T]):
                 self._fill(self._buffers[idx])
             except BaseException as exc:  # re-raised in get()
                 self._fill_error = exc
-        self._pending = threading.Thread(target=run, daemon=True)
-        self._pending.start()
+        # Local import: util must not pull the runtime package (and
+        # its actor/zoo import chain) at module load.
+        from ..runtime import thread_roles
+        self._pending = thread_roles.spawn(
+            thread_roles.BACKGROUND, target=run,
+            name="mv-asyncbuffer-fill")
         self._pending_idx = idx
 
     def get(self) -> T:

@@ -109,10 +109,11 @@ class BufferPool:
         self._enabled = cap > 0 and ncls > 0
         self._cap_bytes = max(cap, 0) << 20
         self._classes = [_MIN_CLASS << i for i in range(max(ncls, 0))]
-        self._free = {size: collections.deque() for size in self._classes}
-        self._resident = 0
-        self._pending: collections.deque = collections.deque()
         self._lock = named_lock(f"buffer_pool[{next(_pool_seq)}]")
+        self._free = {size: collections.deque()  # guarded_by: _lock
+                      for size in self._classes}
+        self._resident = 0  # guarded_by: _lock
+        self._pending: collections.deque = collections.deque()  # guarded_by: _lock
 
     @property
     def enabled(self) -> bool:

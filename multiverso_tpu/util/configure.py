@@ -98,8 +98,10 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "client_cache_rows": 65536,
     # -- updater --
     "updater_type": "default",
-    # -- diagnostics (util/lock_witness.py) --
+    # -- diagnostics (util/lock_witness.py,
+    #    runtime/thread_roles.py) --
     "debug_locks": False,
+    "role_block_budget_ms": 250.0,
     # -- observability (util/tracing.py, runtime/metrics.py,
     #    io/metrics_http.py; docs/OBSERVABILITY.md) --
     "trace_sample_rate": 0.0,

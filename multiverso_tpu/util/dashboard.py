@@ -98,6 +98,11 @@ METRIC_NAMES: Dict[str, str] = {
                          "controller",
     # -- actor mailboxes (util/mt_queue.py track_depth) --
     "MAILBOX_DEPTH[*]": "actor mailbox depth at each push",
+    # -- thread-role blocking watchdog (runtime/thread_roles.py;
+    #    docs/THREADS.md) --
+    "ROLE_BLOCKED_MS[*]": "wall-clock ms a DISPATCH/LIVENESS thread "
+                          "sat blocked past -role_block_budget_ms "
+                          "(per role, -debug_locks watchdog)",
     # -- online serving tier (serving/; docs/SERVING.md) --
     "SERVING_REQUESTS": "serving-frontend requests admitted and served",
     "SERVING_SHED": "serving-frontend requests rejected by admission",

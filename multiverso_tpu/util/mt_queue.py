@@ -37,18 +37,22 @@ def depth_sampling_enabled() -> bool:
 
 class MtQueue(Generic[T]):
     def __init__(self, name: str = "") -> None:
-        self._buffer: Deque[T] = collections.deque()
         name = name or f"mt_queue[{next(_serial)}]"
         self._mutex = named_lock(name)
         self._cond = named_condition(f"{name}.cond", self._mutex)
-        self._exit = False
+        # _cond shares _mutex, so holding either satisfies the guard
+        # (the mvlint guarded-by alias group).
+        self._buffer: Deque[T] = collections.deque()  # guarded_by: _mutex
+        self._exit = False  # guarded_by: _mutex
         # Depth observability (docs/SERVING.md admission control +
         # bench mailbox-pressure reporting): the high watermark is
         # always tracked (one compare per push); per-push depth
         # SAMPLES (p50/p99 via util/dashboard.py Samples) only when a
         # metric name was opted in via track_depth — the reservoir's
         # lock + append per push is real cost on a hot mailbox.
-        self._depth_high = 0
+        self._depth_high = 0  # guarded_by: _mutex
+        # Set once by track_depth before any producer thread runs;
+        # read lock-free per push on purpose.
         self._depth_metric: Optional[str] = None
 
     def track_depth(self, metric_name: str) -> None:

@@ -138,18 +138,51 @@ class TestFixtures:
         assert not [v for v in result.violations
                     if v.pass_name == "copy-lint"]
 
+    def test_thread_role_seeded(self):
+        result = _fixture_result("bad_roles.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "thread-role"]
+        assert len(found) == 5, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        # The PR-6 regression, interprocedurally: the blocking send
+        # sits two helpers below the LIVENESS entry, and the chain
+        # names every hop.
+        assert "blocking net.send() reachable" in messages
+        assert "LIVENESS" in messages
+        assert "_hb_main -> bad_roles.py:SeededMonitor._emit" \
+            in messages
+        assert "raw threading.Thread()" in messages
+        assert "not a literal role constant" in messages
+        assert "without a role" in messages
+        assert "does not resolve" in messages
+        assert result.per_pass_suppressed["thread-role"] == 1
+
+    def test_guarded_by_seeded(self):
+        result = _fixture_result("bad_guards.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "guarded-by"]
+        assert len(found) == 3, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert "registers no such lock with the witness" in messages
+        # Off-lock direct access, and the helper whose caller holds
+        # nothing; the caller-holds helper (_bump) stays silent.
+        assert "in SeededCache.bad_read()" in messages
+        assert "in SeededCache._store()" in messages
+        assert "_bump" not in messages
+        assert result.per_pass_suppressed["guarded-by"] == 1
+
     def test_fixture_dir_fails_as_a_whole(self):
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 27
-        assert len(result.suppressed) == 7
+        assert len(result.violations) == 35
+        assert len(result.suppressed) == 10
 
 
 class TestCleanTree:
     def test_final_tree_is_clean(self):
         # The acceptance gate: the shipped tree has zero non-pragma'd
-        # violations across all eight passes.
+        # violations across all ten passes.
         result = run(("multiverso_tpu", "tests", "bench.py"), REPO_ROOT)
         assert not result.failed, \
             "\n".join(v.render() for v in result.violations)
@@ -201,6 +234,27 @@ class TestCleanTree:
         assert "GHOST_METRIC" in messages          # doc-only row
         assert "NEVER_DOCUMENTED" in messages      # registry-only name
         assert len(found) == 2
+
+    def test_doc_thread_table_matches_registry(self):
+        from tools.mvlint.role_lint import (load_doc_roles,
+                                            load_thread_roles)
+        doc = load_doc_roles(REPO_ROOT)
+        registry, _ = load_thread_roles(REPO_ROOT)
+        assert {e: r for e, (r, _) in doc.items()} == registry
+
+    def test_thread_doc_drift_is_a_violation(self):
+        # _doc_direction fires both ways: a registry entry with no
+        # docs/THREADS.md row, and a stale doc row with no entry.
+        lint = next(p for p in build_passes(REPO_ROOT)
+                    if p.name == "thread-role")
+        lint.doc_roles = dict(lint.doc_roles)
+        entry = sorted(lint.doc_roles)[0]
+        del lint.doc_roles[entry]
+        lint.doc_roles["runtime/ghost.py::Ghost._main"] = ("ACTOR", 999)
+        messages = [v.message for v in lint._doc_direction()]
+        assert any(entry in m and "no row" in m for m in messages)
+        assert any("Ghost._main" in m and "stale" in m
+                   for m in messages)
 
     def test_doc_wire_path_table_matches_lint(self):
         from tools.mvlint.copy_lint import (WIRE_PATH_MODULES,

@@ -321,7 +321,6 @@ class TestTraceTo:
 
 class TestMonitorResetRegression:
     def test_monitor_ctx_survives_dashboard_reset(self):
-        # mvlint: ignore[metric-name]
         # Regression (ISSUE 9 satellite): the context manager used to
         # cache its Monitor at CONSTRUCTION, so a Dashboard.reset()
         # (every bench phase does one) left long-lived monitor(...)

@@ -1,6 +1,6 @@
 """mvlint: project-invariant static analysis for the actor/PS runtime.
 
-Eight passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+Ten passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 (see each module's docstring for the precise rules):
 
 * ``flag-lint`` — every flag access names a canonical registered flag
@@ -26,12 +26,25 @@ Eight passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
   banned on the zero-copy wire-path modules outside pragma-sanctioned
   sites, and the module list is cross-checked against the table in
   ``docs/MEMORY.md`` in both directions.
+* ``thread-role`` — every thread spawns through
+  ``thread_roles.spawn(ROLE, ...)``; the spawn-derived inventory
+  matches ``THREAD_ROLES`` and ``docs/THREADS.md`` both directions;
+  and no DISPATCH/LIVENESS entry can *reach* a blocking primitive
+  through the interprocedural call graph (``callgraph.py`` — the
+  proof-strength successor to the lexical send-discipline ban).
+* ``guarded-by`` — ``# guarded_by: <lock>`` annotated fields are only
+  touched under their witness-registered lock, lexically or via the
+  caller-holds analysis (Clang ``-Wthread-safety`` adapted to
+  ``lock_witness``).
 
 Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
-(``--baseline`` prints per-pass counts without failing). The runtime
-complement — the ``-debug_locks`` lock-order witness — lives in
-``multiverso_tpu/util/lock_witness.py``. Docs:
-``docs/STATIC_ANALYSIS.md``.
+(``--baseline`` prints per-pass counts without failing;
+``--report-unused-pragmas`` lists suppressions that matched nothing).
+The runtime complement — the ``-debug_locks`` lock-order witness and
+the thread-role blocking watchdog — lives in
+``multiverso_tpu/util/lock_witness.py`` and
+``multiverso_tpu/runtime/thread_roles.py``. Docs:
+``docs/STATIC_ANALYSIS.md``, ``docs/THREADS.md``.
 """
 
 from __future__ import annotations
@@ -39,12 +52,15 @@ from __future__ import annotations
 from pathlib import Path
 from typing import List, Sequence
 
+from .callgraph import CallGraph
 from .copy_lint import CopyLint
 from .device_dispatch_lint import DeviceDispatchLint
 from .flag_lint import FlagLint, load_canonical_flags
 from .framework import LintPass, RunResult, Violation, run_passes
+from .guard_lint import GuardedByLint
 from .lock_lint import LockDisciplineLint
 from .metric_lint import MetricNameLint, load_metric_names
+from .role_lint import ThreadRoleLint
 from .send_lint import SendDisciplineLint
 from .tunable_lint import (TunableLint, load_autotune_policies,
                            load_tunable_flags, scan_hook_sites)
@@ -71,6 +87,7 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
     policies = load_autotune_policies(
         root / "multiverso_tpu" / "runtime" / "autotune.py")
     hook_sites = scan_hook_sites(root / "multiverso_tpu")
+    graph = CallGraph.build(root / "multiverso_tpu", root)
     return [
         FlagLint(canonical),
         WireSlotLint(slots, root / "docs" / "WIRE_FORMAT.md",
@@ -82,6 +99,8 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         TunableLint(tunables, canonical, metrics, policies,
                     hook_sites),
         CopyLint(root / "docs" / "MEMORY.md"),
+        ThreadRoleLint(root, graph),
+        GuardedByLint(graph),
     ]
 
 

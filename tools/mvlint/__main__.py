@@ -25,6 +25,12 @@ def main(argv=None) -> int:
                              f"(default: {' '.join(DEFAULT_PATHS)})")
     parser.add_argument("--baseline", action="store_true",
                         help="print per-pass counts, always exit 0")
+    parser.add_argument("--report-unused-pragmas", action="store_true",
+                        help="warn about '# mvlint: ignore[...]' "
+                             "pragmas that suppressed zero findings "
+                             "(stale suppressions are drift); "
+                             "informational, never changes the exit "
+                             "status")
     args = parser.parse_args(argv)
 
     try:
@@ -43,6 +49,12 @@ def main(argv=None) -> int:
         print(violation.render())
     for line in result.info:
         print(f"note: {line}")
+    if args.report_unused_pragmas:
+        for rel, line, name in result.unused_pragmas:
+            print(f"warning: {rel}:{line}: unused pragma "
+                  f"[{name}] — suppresses no finding")
+        print(f"mvlint: {len(result.unused_pragmas)} unused "
+              f"pragma(s)")
     print(f"mvlint: scanned {result.files_scanned} files")
     for name in sorted(set(result.per_pass) | set(result.per_pass_suppressed)):
         count = result.per_pass.get(name, 0)

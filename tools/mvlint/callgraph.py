@@ -1,0 +1,555 @@
+"""Interprocedural core: a package-wide call graph over multiverso_tpu.
+
+Generalizes (and hoists) the device-dispatch pass's jit-seed closure
+into a real name-resolved call graph the reachability passes share:
+
+* **Class table** — every ``class`` in the package, its base names,
+  its methods, and literal class attributes (``ROLE = DISPATCH``);
+  method lookup walks the MRO by name and subclass sets are
+  enumerable (the virtual ``self._main`` binding: ``Actor.start``
+  spawns ``target=self._main``, and the role depends on which
+  subclass the receiver is).
+* **Type inference, deliberately shallow** — ``self._x = Cls(...)``
+  assignments in any method, local ``x = Cls(...)``, parameter and
+  return annotations naming package classes. When a receiver's class
+  is KNOWN the method resolves in that class only; when unknown, a
+  restricted fallback resolves by method name across the package
+  *only if* at most :data:`FALLBACK_CLASS_LIMIT` classes define it —
+  more would be guessing, and a lint must err toward silence
+  (runtime witnesses backstop what the static side skips).
+* **Edges** — plain calls, ``self.m()`` via the binding's MRO,
+  ``mod.f()`` via the import map, class instantiation (an edge to
+  ``__init__``), ``functools.partial(f, ...)`` (an edge to ``f``),
+  and ``threading.Thread(target=...)`` *spawn* references — exposed
+  via :meth:`CallGraph.resolve_callable` but NOT treated as
+  same-thread call edges (the spawned body runs on another thread).
+* **Bounded closure** — :meth:`CallGraph.reachable_calls` walks the
+  graph depth-first from an entry function carrying the class
+  binding, bounded by ``depth`` and a visited set, yielding every
+  call site with the path that reached it (violation messages print
+  the chain — an interprocedural finding is useless without it).
+
+Everything here is pure ``ast``: the package is parsed, never
+imported (the literal-registry principle all mvlint passes follow).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: Unknown-receiver fallback: resolve a method name globally only when
+#: at most this many classes define it (err toward silence past that).
+FALLBACK_CLASS_LIMIT = 3
+
+#: Default bound on the depth-first closure.
+DEPTH_LIMIT = 16
+
+
+def _ann_name(node: Optional[ast.AST]) -> Optional[str]:
+    """The terminal class name an annotation spells, if any:
+    ``_PeerWriter``, ``"_PeerWriter"``, ``Optional[_PeerWriter]``."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip().split("[")[0].split(".")[-1] or None
+    if isinstance(node, ast.Subscript):
+        # Optional[T] / List[T]: the payload is the interesting part.
+        return _ann_name(node.slice)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition."""
+    key: str                      # "<rel>::<qualname>"
+    rel: str                      # module path relative to repo root
+    qual: str                     # dotted qualname within the module
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    cls: Optional[str] = None     # enclosing class name, if a method
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    bases: List[str]
+    methods: Dict[str, FuncInfo]
+    #: literal (constant/Name) class attributes, e.g. ROLE = DISPATCH
+    class_attrs: Dict[str, str]
+
+
+class CallGraph:
+    """Package-wide, name-resolved, deliberately conservative."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, FuncInfo] = {}
+        #: class name -> definitions (collisions keep every one)
+        self.classes: Dict[str, List[ClassInfo]] = {}
+        #: (rel, top-level def name) -> FuncInfo
+        self.module_funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        #: (rel, local name) -> ("class"|"func"|"module", target)
+        self.imports: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        #: module alias -> rel of the package module it names
+        self._module_rels: Dict[str, str] = {}
+        #: (class name, attr) -> class name of the object stored there
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        #: rel -> parsed module (the passes re-walk spawn sites)
+        self.module_trees: Dict[str, ast.AST] = {}
+        #: callee key -> [(caller FuncInfo, call node)]
+        self._callers: Optional[Dict[str, List[Tuple[FuncInfo, ast.Call]]]] = None
+        self._local_types_cache: Dict[str, Dict[str, str]] = {}
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(cls, pkg_root: Path, repo_root: Path) -> "CallGraph":
+        graph = cls()
+        for path in sorted(pkg_root.rglob("*.py")):
+            try:
+                source = path.read_text(encoding="utf-8")
+                tree = ast.parse(source, filename=str(path))
+            except (OSError, SyntaxError):
+                continue
+            graph.add_module(path.relative_to(repo_root).as_posix(), tree)
+        graph.finish()
+        return graph
+
+    def add_module(self, rel: str, tree: ast.AST) -> None:
+        """Index one module (also used to overlay fixture files)."""
+        self.module_trees[rel] = tree
+        self._index_imports(rel, tree)
+        self._index_defs(rel, tree)
+
+    def finish(self) -> None:
+        """Second pass once every class is known: infer self-attr
+        types (the RHS class names must resolve first)."""
+        for infos in self.classes.values():
+            for info in infos:
+                for fn in info.methods.values():
+                    self._index_attr_types(info, fn)
+        self._callers = None
+        self._local_types_cache.clear()
+
+    def with_module(self, rel: str, tree: ast.AST) -> "CallGraph":
+        """A shallow overlay including one extra module — how the
+        passes analyze fixture files without polluting the package
+        graph shared across modules."""
+        overlay = CallGraph()
+        overlay.functions = dict(self.functions)
+        overlay.classes = {k: list(v) for k, v in self.classes.items()}
+        overlay.module_funcs = dict(self.module_funcs)
+        overlay.imports = dict(self.imports)
+        overlay._module_rels = dict(self._module_rels)
+        overlay.attr_types = dict(self.attr_types)
+        overlay.module_trees = dict(self.module_trees)
+        overlay.add_module(rel, tree)
+        overlay.finish()
+        return overlay
+
+    def _index_imports(self, rel: str, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.imports[(rel, local)] = ("name", alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.imports[(rel, local)] = \
+                        ("module", alias.name)
+
+    def _index_defs(self, rel: str, tree: ast.AST) -> None:
+        def visit(node: ast.AST, stack: List[str],
+                  cls_stack: List[Optional[ClassInfo]]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    bases = [b for b in
+                             (_ann_name(base) for base in child.bases)
+                             if b]
+                    info = ClassInfo(child.name, rel, bases, {}, {})
+                    for stmt in child.body:
+                        if isinstance(stmt, ast.Assign) and \
+                                isinstance(stmt.value,
+                                           (ast.Constant, ast.Name,
+                                            ast.Attribute)):
+                            if isinstance(stmt.value, ast.Name):
+                                value = stmt.value.id
+                            elif isinstance(stmt.value, ast.Attribute):
+                                value = stmt.value.attr
+                            else:
+                                value = repr(stmt.value.value)
+                            for tgt in stmt.targets:
+                                if isinstance(tgt, ast.Name):
+                                    info.class_attrs[tgt.id] = value
+                    self.classes.setdefault(child.name, []).append(info)
+                    visit(child, stack + [child.name],
+                          cls_stack + [info])
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    cls = cls_stack[-1]
+                    fn = FuncInfo(f"{rel}::{qual}", rel, qual, child,
+                                  cls.name if cls else None)
+                    self.functions[fn.key] = fn
+                    if cls is not None and len(stack) == 1:
+                        cls.methods[child.name] = fn
+                    if not stack:
+                        self.module_funcs[(rel, child.name)] = fn
+                    visit(child, stack + [child.name],
+                          cls_stack + [None])
+                else:
+                    visit(child, stack, cls_stack)
+
+        visit(tree, [], [None])
+
+    def _index_attr_types(self, info: ClassInfo, fn: FuncInfo) -> None:
+        for node in ast.walk(fn.node):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.value
+                ann = _ann_name(node.annotation)
+                if ann and ann in self.classes and \
+                        isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    self.attr_types[(info.name, target.attr)] = ann
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self" and value is not None):
+                continue
+            cls_name = self._rhs_class(fn.rel, value)
+            if cls_name:
+                self.attr_types[(info.name, target.attr)] = cls_name
+
+    def _rhs_class(self, rel: str, value: ast.AST) -> Optional[str]:
+        """The class instantiated somewhere in an assignment RHS
+        (conditional expressions included — the dispatch-queues
+        pattern is ``X(...) if flag else None``)."""
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                name = _ann_name(sub.func) if isinstance(
+                    sub.func, (ast.Name, ast.Attribute)) else None
+                if name and self._class_named(rel, name):
+                    return name
+        return None
+
+    # ------------------------------------------------------------------
+    # lookup
+    # ------------------------------------------------------------------
+    def _class_named(self, rel: str,
+                     name: str) -> Optional[ClassInfo]:
+        infos = self.classes.get(name)
+        if not infos:
+            target = self.imports.get((rel, name))
+            if target and target[0] == "name":
+                infos = self.classes.get(target[1].split(".")[-1])
+        if not infos:
+            return None
+        for info in infos:
+            if info.rel == rel:
+                return info
+        return infos[0]
+
+    def mro(self, cls_name: str,
+            rel: Optional[str] = None) -> List[ClassInfo]:
+        """Linearized-by-name base walk (good enough for this
+        package's single-inheritance classes)."""
+        out: List[ClassInfo] = []
+        seen: Set[str] = set()
+
+        def walk(name: str, at: Optional[str]) -> None:
+            if name in seen:
+                return
+            seen.add(name)
+            info = self._class_named(at or "", name)
+            if info is None:
+                return
+            out.append(info)
+            for base in info.bases:
+                walk(base, info.rel)
+
+        walk(cls_name, rel)
+        return out
+
+    def lookup_method(self, cls_name: str, method: str,
+                      rel: Optional[str] = None) -> Optional[FuncInfo]:
+        for info in self.mro(cls_name, rel):
+            fn = info.methods.get(method)
+            if fn is not None:
+                return fn
+        return None
+
+    def class_attr(self, cls_name: str, attr: str,
+                   rel: Optional[str] = None) -> Optional[str]:
+        for info in self.mro(cls_name, rel):
+            if attr in info.class_attrs:
+                return info.class_attrs[attr]
+        return None
+
+    def subclasses(self, cls_name: str) -> List[ClassInfo]:
+        """``cls_name`` plus every transitive subclass in the package."""
+        out: List[ClassInfo] = []
+        names = {cls_name}
+        changed = True
+        while changed:
+            changed = False
+            for infos in self.classes.values():
+                for info in infos:
+                    if info.name in names:
+                        continue
+                    if any(base in names for base in info.bases):
+                        names.add(info.name)
+                        changed = True
+        for name in names:
+            out.extend(self.classes.get(name, []))
+        return sorted(out, key=lambda i: (i.rel, i.name))
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+    def _local_types(self, fn: FuncInfo) -> Dict[str, str]:
+        """Parameter annotations + ``x = Cls(...)`` locals +
+        call-returns whose callee annotates a package class."""
+        cached = self._local_types_cache.get(fn.key)
+        if cached is not None:
+            return cached
+        types: Dict[str, str] = {}
+        self._local_types_cache[fn.key] = types
+        args = fn.node.args
+        for arg in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            ann = _ann_name(arg.annotation)
+            if ann and self._class_named(fn.rel, ann):
+                types[arg.arg] = ann
+        for node in ast.walk(fn.node):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            cls = self._rhs_class(fn.rel, node.value)
+            if cls:
+                types[name] = cls
+                continue
+            if isinstance(node.value, ast.Call):
+                for target, _ in self._resolve(node.value.func, fn,
+                                               None, as_call=True):
+                    ret = _ann_name(getattr(target.node, "returns",
+                                            None))
+                    if ret and self._class_named(target.rel, ret):
+                        types[name] = ret
+                        break
+        return types
+
+    def resolve_call(self, call: ast.Call, fn: FuncInfo,
+                     binding: Optional[str]) -> List[Tuple[FuncInfo,
+                                                           Optional[str]]]:
+        """Resolve a call site to (callee, callee class binding)
+        pairs. ``binding`` is the concrete class ``self`` is bound to
+        in ``fn`` (for virtual methods: ``Actor._main`` walked with
+        binding ``Communicator`` resolves ``self._dispatch`` to the
+        override)."""
+        return self._resolve(call.func, fn, binding, as_call=True)
+
+    def resolve_callable(self, expr: ast.AST, fn: FuncInfo,
+                         binding: Optional[str]) -> List[Tuple[
+                             FuncInfo, Optional[str]]]:
+        """Resolve a callable *reference* (Thread target, partial
+        payload, callback argument) without calling it."""
+        if isinstance(expr, ast.Call):
+            # functools.partial(f, ...) used as the callable.
+            name = expr.func.attr if isinstance(expr.func, ast.Attribute) \
+                else (expr.func.id if isinstance(expr.func, ast.Name)
+                      else None)
+            if name == "partial" and expr.args:
+                return self.resolve_callable(expr.args[0], fn, binding)
+            return []
+        return self._resolve(expr, fn, binding, as_call=False)
+
+    def _resolve(self, func: ast.AST, fn: FuncInfo,
+                 binding: Optional[str],
+                 as_call: bool) -> List[Tuple[FuncInfo, Optional[str]]]:
+        if isinstance(func, ast.Name):
+            return self._resolve_name(func.id, fn, binding, as_call)
+        if isinstance(func, ast.Attribute):
+            return self._resolve_attr(func, fn, binding, as_call)
+        return []
+
+    def _resolve_name(self, name: str, fn: FuncInfo,
+                      binding: Optional[str],
+                      as_call: bool) -> List[Tuple[FuncInfo,
+                                                   Optional[str]]]:
+        # Nested def in the enclosing function's scope chain.
+        parts = fn.qual.split(".")
+        for depth in range(len(parts), 0, -1):
+            key = f"{fn.rel}::{'.'.join(parts[:depth] + [name])}"
+            nested = self.functions.get(key)
+            if nested is not None:
+                return [(nested, binding)]
+        top = self.module_funcs.get((fn.rel, name))
+        if top is not None:
+            return [(top, None)]
+        info = self._class_named(fn.rel, name)
+        if info is not None:
+            if not as_call:
+                return []
+            init = self.lookup_method(info.name, "__init__", info.rel)
+            return [(init, info.name)] if init else []
+        target = self.imports.get((fn.rel, name))
+        if target and target[0] == "name":
+            leaf = target[1].split(".")[-1]
+            for (rel, fname), other in self.module_funcs.items():
+                if fname == leaf and rel != fn.rel:
+                    return [(other, None)]
+        return []
+
+    def _resolve_attr(self, func: ast.Attribute, fn: FuncInfo,
+                      binding: Optional[str],
+                      as_call: bool) -> List[Tuple[FuncInfo,
+                                                   Optional[str]]]:
+        method = func.attr
+        recv = func.value
+        # self.m() / self.attr.m()
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and fn.cls is not None:
+                cls = binding or fn.cls
+                target = self.lookup_method(cls, method, fn.rel)
+                if target is not None:
+                    return [(target, cls)]
+                return self._fallback(method)
+            local = self._local_types(fn).get(recv.id)
+            if local:
+                target = self.lookup_method(local, method, fn.rel)
+                return [(target, local)] if target else []
+            imported = self.imports.get((fn.rel, recv.id))
+            if imported and imported[0] == "module":
+                leaf = imported[1].split(".")[-1]
+                for (rel, fname), other in self.module_funcs.items():
+                    if fname == method and \
+                            rel.endswith(f"/{leaf}.py"):
+                        return [(other, None)]
+                return []
+            return self._fallback(method)
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and fn.cls is not None:
+            holder = binding or fn.cls
+            for info in self.mro(holder, fn.rel):
+                typed = self.attr_types.get((info.name, recv.attr))
+                if typed:
+                    target = self.lookup_method(typed, method, fn.rel)
+                    return [(target, typed)] if target else []
+        return self._fallback(method)
+
+    #: Method names shared with builtin containers/IO: an unknown
+    #: receiver bearing one is far more likely a dict/list/socket
+    #: than a package class — resolving would fabricate edges.
+    _BUILTIN_LIKE = frozenset({
+        "get", "pop", "push", "append", "add", "clear", "update",
+        "copy", "items", "keys", "values", "extend", "remove",
+        "discard", "insert", "close", "join", "start", "sort",
+        "count", "index", "put", "send", "recv", "read", "write",
+        "flush", "stop",
+    })
+
+    def _fallback(self, method: str) -> List[Tuple[FuncInfo,
+                                                   Optional[str]]]:
+        """Unknown receiver: resolve by method name package-wide only
+        when few classes define it (err toward silence)."""
+        if method.startswith("__") or method in self._BUILTIN_LIKE:
+            return []
+        owners = [info for infos in self.classes.values()
+                  for info in infos if method in info.methods]
+        if not owners or len(owners) > FALLBACK_CLASS_LIMIT:
+            return []
+        return [(info.methods[method], info.name) for info in owners]
+
+    # ------------------------------------------------------------------
+    # closure
+    # ------------------------------------------------------------------
+    def reachable_calls(self, fn: FuncInfo, binding: Optional[str],
+                        depth: int = DEPTH_LIMIT,
+                        prune=None) -> Iterator[Tuple[FuncInfo,
+                                                      ast.Call,
+                                                      Tuple[str, ...]]]:
+        """Depth-first closure from ``fn``: yields every reachable
+        call site as (enclosing function, call node, path of function
+        keys from the entry). ``prune(func, call)`` returning True
+        stops traversal INTO that call's resolutions (but the site is
+        still yielded first) — pass 9 prunes at blocking primitives
+        so transport internals below a finding stay quiet."""
+        visited: Set[Tuple[str, Optional[str]]] = set()
+
+        def walk(cur: FuncInfo, bound: Optional[str],
+                 path: Tuple[str, ...],
+                 budget: int) -> Iterator[Tuple[FuncInfo, ast.Call,
+                                                Tuple[str, ...]]]:
+            if budget <= 0 or (cur.key, bound) in visited:
+                return
+            visited.add((cur.key, bound))
+            here = path + (cur.key,)
+            for call in self._calls_in(cur):
+                yield cur, call, here
+                if prune is not None and prune(cur, call):
+                    continue
+                if self._spawns_thread(call):
+                    continue  # runs on another thread, not this path
+                for callee, callee_bound in self.resolve_call(
+                        call, cur, bound):
+                    yield from walk(callee, callee_bound, here,
+                                    budget - 1)
+
+        yield from walk(fn, binding, (), depth)
+
+    def _calls_in(self, fn: FuncInfo) -> List[ast.Call]:
+        """Call sites lexically inside ``fn`` but not inside a nested
+        def (those run when the nested function does)."""
+        out: List[ast.Call] = []
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                visit(child)
+
+        visit(fn.node)
+        return out
+
+    @staticmethod
+    def _spawns_thread(call: ast.Call) -> bool:
+        name = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else (call.func.id if isinstance(call.func, ast.Name)
+                  else None)
+        return name in ("Thread", "spawn") and \
+            any(kw.arg == "target" for kw in call.keywords)
+
+    def callers_of(self, key: str) -> List[Tuple[FuncInfo, ast.Call]]:
+        """Reverse edges: every call site in the graph that resolves
+        to ``key`` (used by the guarded-by caller-holds analysis)."""
+        if self._callers is None:
+            self._callers = {}
+            for fn in list(self.functions.values()):
+                for call in self._calls_in(fn):
+                    for callee, _ in self.resolve_call(call, fn, None):
+                        self._callers.setdefault(
+                            callee.key, []).append((fn, call))
+        return self._callers.get(key, [])

@@ -1,0 +1,52 @@
+"""guarded-by fixture: seeded violations (never imported).
+
+Expected findings (tests/test_mvlint.py pins the counts):
+  line A: annotation names a lock the witness never
+          registered for this class                  -> violation
+  line B: off-lock read of a guarded field           -> violation
+  line C: write of a guarded field in a helper whose
+          caller does NOT hold the lock              -> violation
+  line D: pragma'd off-lock write                    -> suppressed
+Clean: lexical 'with self._lock' access, a caller-holds helper
+(every caller holds the lock), the condition/lock alias group, and
+__init__'s construction window.
+"""
+
+from multiverso_tpu.util.lock_witness import named_condition, named_lock
+
+
+class SeededCache:
+    def __init__(self):
+        self._lock = named_lock("fixture.guards.lock")
+        # named_condition(name, lock) SHARES the lock: holding either
+        # satisfies annotations naming the other.
+        self._cond = named_condition("fixture.guards.cond", self._lock)
+        self._rows = {}  # guarded_by: _lock
+        self._depth = 0  # guarded_by: _cond
+        self._tag = ""  # guarded_by: _ghost   (A: unwitnessed lock)
+
+    def ok_lexical(self, key, value):
+        with self._lock:
+            self._rows[key] = value
+
+    def bad_read(self):
+        return len(self._rows)                                   # B
+
+    def bad_write_caller(self):
+        # The violation lands inside _store: this caller holds
+        # nothing, so caller-holds cannot vouch for the write.
+        self._store(1, 2)
+
+    def _store(self, key, value):
+        self._rows[key] = value                                  # C
+
+    def ok_caller_holds(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        # Clean: every caller holds _lock, and _cond aliases it.
+        self._depth += 1
+
+    def suppressed_reset(self):
+        self._depth = 0  # mvlint: ignore[guarded-by]  (D)

@@ -1,0 +1,70 @@
+"""thread-role fixture: seeded violations (never imported).
+
+Expected findings (tests/test_mvlint.py pins the counts):
+  line A: raw threading.Thread() in scanned code      -> violation
+  line B: LIVENESS entry reaches net.send two helpers
+          deep (the PR-6 heartbeat regression, caught
+          interprocedurally at the send site)          -> violation
+  line C: role is not a literal role constant         -> violation
+  line D: spawn(...) without a role                   -> violation
+  line E: target does not resolve to a known def      -> violation
+  line F: pragma'd raw Thread                         -> suppressed
+Clean: BACKGROUND spawns (may block), a DISPATCH entry that only
+uses send_async, and a functools.partial target.
+"""
+
+import functools
+import threading
+
+from multiverso_tpu.runtime.thread_roles import (
+    BACKGROUND, DISPATCH, LIVENESS, spawn)
+
+UNKNOWN_CALLABLE = None
+
+
+class SeededMonitor:
+    """The PR-6 failure class, reachability edition: the blocking
+    send hides two helpers below the LIVENESS entry point, so the
+    old lexical send-ban never sees it from the spawn site."""
+
+    def __init__(self, net):
+        self._net = net
+        self._raw = threading.Thread(target=self._hb_main)       # A
+        self._thread = spawn(LIVENESS, target=self._hb_main)
+
+    def _hb_main(self):
+        while True:
+            self._emit({"hb": 1})
+
+    def _emit(self, frame):
+        self._push(frame)
+
+    def _push(self, frame):
+        # B: the lexical pass-6 ban is pragma'd away on purpose —
+        # pass 9 must still catch this through the call graph.
+        self._net.send(frame)  # mvlint: ignore[send-discipline]
+
+    def bad_spawns(self):
+        spawn("TURBO", target=self._hb_main)                     # C
+        spawn(target=self._hb_main)                              # D
+        spawn(BACKGROUND, target=UNKNOWN_CALLABLE)               # E
+
+    def legacy(self):
+        return threading.Thread(  # mvlint: ignore[thread-role]  (F)
+            target=self._fill)
+
+    def start_ok(self):
+        # Clean: BACKGROUND threads may block; the registry gate
+        # applies to package spawn sites only.
+        spawn(BACKGROUND, target=self._fill)
+        spawn(BACKGROUND, target=functools.partial(self._fill, 3))
+        # Clean: DISPATCH entry whose whole reachable surface is
+        # non-blocking (send_async is the sanctioned form).
+        spawn(DISPATCH, target=self._drain)
+
+    def _fill(self, n=1):
+        return [{}] * n
+
+    def _drain(self):
+        while True:
+            self._net.send_async({"d": 1})

@@ -55,7 +55,7 @@ class ModuleInfo:
         self.pragmas: Dict[int, Set[str]] = {}
         self._collect_pragmas()
         #: line ranges suppressed per pass via a pragma on a def/class
-        #: line: pass -> list of (first_line, last_line)
+        #: line: pass -> list of (first_line, last_line, pragma_line)
         self.pragma_spans: Dict[str, List[tuple]] = {}
         self._collect_spans()
 
@@ -85,21 +85,28 @@ class ModuleInfo:
             names = self.pragmas.get(node.lineno, set())
             if not names:
                 continue
-            span = (node.lineno, node.end_lineno or node.lineno)
+            span = (node.lineno, node.end_lineno or node.lineno,
+                    node.lineno)
             for name in names:
                 self.pragma_spans.setdefault(name, []).append(span)
 
-    def suppressed(self, violation: Violation) -> bool:
+    def matching_pragmas(self, violation: Violation) -> List[tuple]:
+        """Every pragma entry — as (pragma line, pass name) — that
+        suppresses ``violation`` (used-pragma accounting for
+        ``--report-unused-pragmas``)."""
+        hits: List[tuple] = []
         names = self.pragmas.get(violation.line, set())
-        if violation.pass_name in names or "*" in names:
-            return True
-        for lo, hi in self.pragma_spans.get(violation.pass_name, []):
-            if lo <= violation.line <= hi:
-                return True
-        for lo, hi in self.pragma_spans.get("*", []):
-            if lo <= violation.line <= hi:
-                return True
-        return False
+        for name in (violation.pass_name, "*"):
+            if name in names:
+                hits.append((violation.line, name))
+        for name in (violation.pass_name, "*"):
+            for lo, hi, origin in self.pragma_spans.get(name, []):
+                if lo <= violation.line <= hi:
+                    hits.append((origin, name))
+        return hits
+
+    def suppressed(self, violation: Violation) -> bool:
+        return bool(self.matching_pragmas(violation))
 
     def segment(self, node: ast.AST) -> str:
         return ast.get_source_segment(self.source, node) or ""
@@ -145,6 +152,10 @@ class RunResult:
     per_pass_suppressed: Dict[str, int]
     info: List[str]
     files_scanned: int
+    #: (rel path, line, pass name) of every pragma that suppressed
+    #: ZERO findings this run — stale suppressions are drift.
+    unused_pragmas: List[tuple] = dataclasses.field(
+        default_factory=list)
 
     @property
     def failed(self) -> bool:
@@ -160,6 +171,8 @@ def run_passes(passes: Iterable[LintPass], paths: Sequence[str],
     per_pass = {p.name: 0 for p in passes}
     per_sup = {p.name: 0 for p in passes}
     scanned = 0
+    all_pragmas: Set[tuple] = set()
+    used_pragmas: Set[tuple] = set()
     for path in files:
         try:
             module = ModuleInfo(path, root)
@@ -170,6 +183,9 @@ def run_passes(passes: Iterable[LintPass], paths: Sequence[str],
             per_pass["parse"] = per_pass.get("parse", 0) + 1
             continue
         scanned += 1
+        for line, names in module.pragmas.items():
+            for name in names:
+                all_pragmas.add((module.rel, line, name))
         for lint in passes:
             for v in lint.check(module):
                 # A pass may report against ANOTHER file (the wire-slot
@@ -178,6 +194,8 @@ def run_passes(passes: Iterable[LintPass], paths: Sequence[str],
                 if v.path == module.rel and module.suppressed(v):
                     suppressed.append(v)
                     per_sup[lint.name] += 1
+                    for line, name in module.matching_pragmas(v):
+                        used_pragmas.add((module.rel, line, name))
                 else:
                     violations.append(v)
                     per_pass[lint.name] += 1
@@ -186,4 +204,5 @@ def run_passes(passes: Iterable[LintPass], paths: Sequence[str],
         info.extend(lint.tree_report())
     violations.sort(key=lambda v: (v.path, v.line, v.col))
     return RunResult(violations, suppressed, per_pass, per_sup,
-                     info, scanned)
+                     info, scanned,
+                     unused_pragmas=sorted(all_pragmas - used_pragmas))

@@ -1,0 +1,311 @@
+"""guarded-by lint (pass 10): annotated instance fields stay under
+their lock — lexically or because every caller holds it.
+
+Clang ``-Wthread-safety`` / Java ``@GuardedBy`` adapted to this
+codebase's ``lock_witness`` registry. A field opts in with a comment
+on (or immediately above) the assignment that creates it:
+
+    self._frames: list = []  # guarded_by: _cond
+
+Then, in that module:
+
+* the named lock must itself be **witness-registered** in the same
+  class — assigned from ``named_lock``/``named_rlock``/
+  ``named_condition`` (``util/lock_witness.py``) — so an annotation
+  can never name a lock the runtime witness doesn't know;
+* ``named_condition(name, lock)`` SHARES the passed lock, so the
+  condition and its lock form an **alias group**: holding either
+  satisfies an annotation naming the other (the MtQueue/_DispatchQueues
+  pattern);
+* every read/write of ``self.<field>`` in the annotated class must
+  sit under ``with <lock>`` (or ``acquire_timeout(<lock>, ...)``)
+  **lexically**, or in a function whose every resolvable call site —
+  found through the package call graph, same module only — is itself
+  under the lock (**caller-holds**, bounded depth; the
+  ``_store_locked``/``_report_locked`` idiom);
+* ``__init__`` is exempt (the construction window publishes the
+  object only at the end), and calls *from* ``__init__`` count as
+  holding for the same reason.
+
+Scope is deliberately module-local and name-matched (a ``with
+x._lock`` on another object's lock of the same attribute name
+passes): the pass proves the discipline the module declares for
+itself and errs toward silence past that — ``-debug_locks``'s
+runtime witness backstops the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncInfo
+from .framework import LintPass, ModuleInfo, Violation
+
+GUARD_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+WITNESS_FACTORIES = {"named_lock", "named_rlock", "named_condition"}
+
+#: caller-holds recursion bound (a chain deeper than this is not
+#: evidence, it's a maze).
+HOLD_DEPTH = 4
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _ClassFacts:
+    """Per-class annotation/lock tables for one module."""
+
+    def __init__(self) -> None:
+        #: field -> (lock name, annotation line)
+        self.guards: Dict[str, Tuple[str, int]] = {}
+        #: witness-registered lock attrs -> factory name
+        self.locks: Dict[str, str] = {}
+        #: lock attr -> full alias closure (incl. itself)
+        self.aliases: Dict[str, Set[str]] = {}
+
+
+class GuardedByLint(LintPass):
+    name = "guarded-by"
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self._fields_total = 0
+        self._modules_with: Set[str] = set()
+        self._caller_holds_uses = 0
+
+    # -- comment collection ------------------------------------------
+    @staticmethod
+    def _guard_comments(module: ModuleInfo) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                iter(module.source.splitlines(keepends=True)).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = GUARD_RE.search(tok.string)
+                if m:
+                    out[tok.start[0]] = m.group(1)
+        except tokenize.TokenError:
+            pass
+        return out
+
+    # -- main ---------------------------------------------------------
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        comments = self._guard_comments(module)
+        if not comments:
+            return
+        graph = self.graph
+        if module.rel not in graph.module_trees:
+            graph = graph.with_module(module.rel, module.tree)
+        facts, errors = self._collect(module, comments, graph)
+        yield from errors
+        n_fields = sum(len(f.guards) for f in facts.values())
+        if n_fields:
+            self._fields_total += n_fields
+            self._modules_with.add(module.rel)
+        # Lexical held-sets for every function in the module (also
+        # feeds caller-holds), then the access check.
+        held_at: Dict[ast.Call, frozenset] = {}
+        accesses: List[Tuple[str, FuncInfo, ast.Attribute,
+                             frozenset]] = []
+        funcs = [fn for fn in graph.functions.values()
+                 if fn.rel == module.rel]
+        for fn in funcs:
+            if fn.cls is None and "." in fn.qual:
+                continue  # nested defs are scanned inside their parent
+            self._scan_fn(fn, fn.node, frozenset(), held_at, accesses)
+        holds_cache: Dict[Tuple[str, str, str], Optional[bool]] = {}
+        for cls, fn, node, held in accesses:
+            cf = facts.get(cls)
+            if cf is None:
+                continue
+            guard = cf.guards.get(node.attr)
+            if guard is None:
+                continue
+            lock, _ = guard
+            wanted = cf.aliases.get(lock, {lock})
+            if held & wanted:
+                continue
+            if fn.name == "__init__":
+                continue  # construction window
+            if self._caller_holds(module, graph, fn, wanted, held_at,
+                                  holds_cache, HOLD_DEPTH):
+                self._caller_holds_uses += 1
+                continue
+            kind = "write" if isinstance(node.ctx,
+                                         (ast.Store, ast.Del)) \
+                else "read"
+            yield Violation(
+                module.rel, node.lineno, node.col_offset, self.name,
+                f"{kind} of {cls}.{node.attr} (guarded_by {lock}) "
+                f"outside 'with self.{lock}' in {fn.qual}() — not "
+                f"lexically held and not every caller holds it "
+                f"(docs/STATIC_ANALYSIS.md pass 10)")
+
+    # -- tables -------------------------------------------------------
+    def _collect(self, module: ModuleInfo, comments: Dict[int, str],
+                 graph: CallGraph):
+        facts: Dict[str, _ClassFacts] = {}
+        errors: List[Violation] = []
+        #: line -> (class, field) for every self.<field> assignment
+        assign_at: Dict[int, Tuple[str, str]] = {}
+        for fn in graph.functions.values():
+            if fn.rel != module.rel or fn.cls is None:
+                continue
+            cf = facts.setdefault(fn.cls, _ClassFacts())
+            for node in ast.walk(fn.node):
+                target = value = None
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value = node.target, node.value
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                assign_at.setdefault(node.lineno,
+                                     (fn.cls, target.attr))
+                if isinstance(value, ast.Call):
+                    factory = _root_name(value.func)
+                    if factory in WITNESS_FACTORIES:
+                        cf.locks[target.attr] = factory
+                        if factory == "named_condition" \
+                                and len(value.args) >= 2:
+                            other = _root_name(value.args[1])
+                            if other:
+                                group = (cf.aliases.get(target.attr,
+                                                        set())
+                                         | cf.aliases.get(other,
+                                                          set())
+                                         | {target.attr, other})
+                                for name in group:
+                                    cf.aliases[name] = group
+        for cls, cf in facts.items():
+            for lock in cf.locks:
+                cf.aliases.setdefault(lock, {lock})
+        for line, lock in sorted(comments.items()):
+            hit = assign_at.get(line) or assign_at.get(line + 1)
+            if hit is None:
+                errors.append(Violation(
+                    module.rel, line, 0, self.name,
+                    "guarded_by annotation is not attached to a "
+                    "self.<field> assignment (same line or the line "
+                    "below)"))
+                continue
+            cls, field = hit
+            cf = facts[cls]
+            known = cf.guards.get(field)
+            if known is not None and known[0] != lock:
+                errors.append(Violation(
+                    module.rel, line, 0, self.name,
+                    f"{cls}.{field} annotated guarded_by {lock} here "
+                    f"but guarded_by {known[0]} at line {known[1]} — "
+                    f"one field, one lock"))
+                continue
+            cf.guards[field] = (lock, line)
+            if lock not in cf.locks:
+                errors.append(Violation(
+                    module.rel, line, 0, self.name,
+                    f"guarded_by names {lock!r} but {cls} registers "
+                    f"no such lock with the witness (named_lock/"
+                    f"named_rlock/named_condition, "
+                    f"util/lock_witness.py) — the annotation must "
+                    f"name a lock the witness knows"))
+        return facts, errors
+
+    # -- lexical scan -------------------------------------------------
+    def _scan_fn(self, fn: FuncInfo, node: ast.AST, held: frozenset,
+                 held_at: Dict[ast.Call, frozenset],
+                 accesses: List) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                # Runs later: locks held here are not held there.
+                self._scan_fn(fn, child, frozenset(), held_at,
+                              accesses)
+                continue
+            if isinstance(child, ast.With):
+                new_held = set(held)
+                for item in child.items:
+                    self._scan_fn(fn, item.context_expr, held,
+                                  held_at, accesses)
+                    expr = item.context_expr
+                    name = _root_name(expr)
+                    if isinstance(expr, ast.Call):
+                        # acquire_timeout(self._lock, ...) holds it.
+                        if _root_name(expr.func) == "acquire_timeout" \
+                                and expr.args:
+                            name = _root_name(expr.args[0])
+                        else:
+                            name = None
+                    if name:
+                        new_held.add(name)
+                frozen = frozenset(new_held)
+                for stmt in child.body:
+                    self._scan_fn(fn, stmt, frozen, held_at, accesses)
+                continue
+            if isinstance(child, ast.Call):
+                held_at[child] = held
+            if isinstance(child, ast.Attribute) and \
+                    isinstance(child.value, ast.Name) and \
+                    child.value.id == "self" and fn.cls is not None:
+                accesses.append((fn.cls, fn, child, held))
+            self._scan_fn(fn, child, held, held_at, accesses)
+
+    # -- caller-holds -------------------------------------------------
+    def _caller_holds(self, module: ModuleInfo, graph: CallGraph,
+                      fn: FuncInfo, wanted: Set[str],
+                      held_at: Dict[ast.Call, frozenset],
+                      cache: Dict, depth: int) -> bool:
+        key = (fn.key, tuple(sorted(wanted)))
+        if key in cache:
+            return bool(cache[key])
+        if depth <= 0:
+            return False
+        cache[key] = False  # cycle: a recursive chain is not evidence
+        callers: List[Tuple[FuncInfo, ast.Call]] = []
+        for other in graph.functions.values():
+            if other.rel != module.rel or other is fn:
+                continue
+            for call in graph._calls_in(other):
+                for callee, _ in graph.resolve_call(call, other, None):
+                    if callee.key == fn.key:
+                        callers.append((other, call))
+                        break
+        if not callers:
+            cache[key] = False
+            return False
+        for caller, call in callers:
+            if caller.name == "__init__":
+                continue  # construction window counts as held
+            held = held_at.get(call)
+            if held is None:
+                cache[key] = False
+                return False
+            if held & wanted:
+                continue
+            if not self._caller_holds(module, graph, caller, wanted,
+                                      held_at, cache, depth - 1):
+                cache[key] = False
+                return False
+        cache[key] = True
+        return True
+
+    def tree_report(self) -> List[str]:
+        return [f"guarded-by: {self._fields_total} annotated fields "
+                f"across {len(self._modules_with)} modules; "
+                f"caller-holds satisfied "
+                f"{self._caller_holds_uses} accesses"]
