@@ -1323,16 +1323,19 @@ def run_wire_codec() -> dict:
 
 @flag_guarded
 def _wire_pump(zero_copy: bool, n_msgs: int, rows: int,
-               dims: int = 256) -> dict:
+               dims: int = 256, shm: bool = False) -> dict:
     """One arm of the ``zero_copy`` phase: large-blob PS-shaped traffic
     over loopback TCP — rank 0 streams ``n_msgs`` Get replies' worth of
     (rows x dims) fp32 payload to rank 1, which echoes each frame's
     blob straight back (the serving read shape: big payloads both
     directions, and the echo re-serializes RECEIVED view-backed blobs).
     Serialization — not the wire — dominates on loopback, which is
-    exactly where the copy count shows. Returns rows/s and the measured
-    copied-bytes-per-payload-byte off the WIRE_BYTES_COPIED /
-    WIRE_PAYLOAD_BYTES counters."""
+    exactly where the copy count shows. ``shm=True`` negotiates the
+    pair onto shared-memory rings (docs/MEMORY.md "Below the socket"):
+    same traffic, same counters, zero wire syscalls — slots sized so a
+    whole frame fits one slot and the receive side parses in place.
+    Returns rows/s and the measured copied-bytes-per-payload-byte off
+    the WIRE_BYTES_COPIED / WIRE_PAYLOAD_BYTES counters."""
     import threading
     from multiverso_tpu.core.blob import Blob
     from multiverso_tpu.core.message import Message, MsgType
@@ -1346,6 +1349,15 @@ def _wire_pump(zero_copy: bool, n_msgs: int, rows: int,
     Dashboard.reset()
     eps = [f"127.0.0.1:{free_listen_port()}" for _ in range(2)]
     nets = [TcpNet(r, eps) for r in range(2)]
+    if shm:
+        from multiverso_tpu.runtime.shm import ShmNet
+        # 8 slots keeps the echo's in-flight window under the pin
+        # valve (half the ring), so frames stay zero-copy end to end.
+        set_flag("shm_ring_slots", 8)
+        set_flag("shm_slot_kb", 8192)  # a 4 MB frame fits one slot
+        nets = [ShmNet(n) for n in nets]
+        for n in nets:
+            n.enable_shm(0x6B3A, [1 - n.rank])
     try:
         payload = np.arange(rows * dims, dtype=np.float32)
         errs = []
@@ -1385,7 +1397,7 @@ def _wire_pump(zero_copy: bool, n_msgs: int, rows: int,
         pool_hits = Dashboard.get("POOL_HIT").count
         pool_miss = Dashboard.get("POOL_MISS").count
         total_rows = n_msgs * rows * 2  # both directions
-        return {
+        out = {
             "rows_per_sec": round(total_rows / elapsed, 0),
             "payload_mb_per_sec": round(
                 n_msgs * payload.nbytes * 2 / elapsed / 1e6, 1),
@@ -1394,6 +1406,11 @@ def _wire_pump(zero_copy: bool, n_msgs: int, rows: int,
                 copied / max(payload_bytes, 1), 6),
             "pool_hits": pool_hits, "pool_misses": pool_miss,
         }
+        if shm:
+            out["shm_frames"] = Dashboard.get("SHM_FRAMES").count
+            out["shm_bytes_copied"] = \
+                Dashboard.get("SHM_BYTES_COPIED").count
+        return out
     finally:
         for n in nets:
             n.finalize()
@@ -1424,8 +1441,16 @@ def run_zero_copy() -> dict:
         and nbytes == len(flat)
 
     n_msgs, rows = 64, 4096  # 4 MB blobs: an embedding-table Get reply
-    zc = _wire_pump(True, n_msgs, rows)
-    base = _wire_pump(False, n_msgs, rows)
+
+    def best_of(arms):
+        """Best-of-2 per arm: the pumps share one GIL with their echo
+        threads, so single runs are scheduling-noisy; the max is the
+        honest capability number for a throughput arm."""
+        runs = [arms() for _ in range(2)]
+        return max(runs, key=lambda r: r["rows_per_sec"])
+
+    zc = best_of(lambda: _wire_pump(True, n_msgs, rows))
+    base = best_of(lambda: _wire_pump(False, n_msgs, rows))
     out = {
         "frames_byte_identical": identical,
         "blob_mb": round(rows * 256 * 4 / 1e6, 2),
@@ -1437,6 +1462,18 @@ def run_zero_copy() -> dict:
         "rows_per_sec_speedup": round(
             zc["rows_per_sec"] / max(base["rows_per_sec"], 1), 3),
     }
+    # Below the socket (docs/MEMORY.md): the same echo traffic with the
+    # pair negotiated onto shared-memory rings. Acceptance: rows/s
+    # >= 1.3x the loopback-TCP zero-copy arm, and shm_bytes_copied ~ 0
+    # (single-slot frames parse in place on the receive side).
+    from multiverso_tpu.runtime import shm as shm_mod
+    if shm_mod.supported():
+        with flag_guard():
+            shm_echo = best_of(
+                lambda: _wire_pump(True, n_msgs, rows, shm=True))
+        out["shm_echo"] = shm_echo
+        out["shm_rows_per_sec_speedup_vs_tcp"] = round(
+            shm_echo["rows_per_sec"] / max(zc["rows_per_sec"], 1), 3)
     # Allreduce over loopback: the collective's segment frames ride the
     # same framer; dense 4 MB fp32, forced ring, codec on (RAW frames
     # pass the payload as a zero-copy view).
@@ -1444,12 +1481,24 @@ def run_zero_copy() -> dict:
         from multiverso_tpu.util.configure import set_flag
         set_flag("zero_copy", True)
         ar_zc = _allreduce_world(2, "ring", 0.0, False, "tcp", 1 << 20)
+        ar_shm = None
+        if shm_mod.supported():
+            # Enough slots that the engine's out-of-order stash (its
+            # pipelined segment window) stays under the pin valve.
+            set_flag("shm_ring_slots", 16)
+            set_flag("shm_slot_kb", 4096)
+            ar_shm = _allreduce_world(2, "ring", 0.0, False, "shm",
+                                      1 << 20)
         set_flag("zero_copy", False)
         set_flag("buffer_pool_mb", 0)
         ar_base = _allreduce_world(2, "ring", 0.0, False, "tcp", 1 << 20)
     out["allreduce"] = {
         "zero_copy": ar_zc, "copy_baseline": ar_base,
         "speedup": round(ar_base["sec"] / max(ar_zc["sec"], 1e-9), 3)}
+    if ar_shm is not None:
+        out["allreduce"]["shm"] = ar_shm
+        out["allreduce"]["shm_speedup_vs_tcp"] = round(
+            ar_zc["sec"] / max(ar_shm["sec"], 1e-9), 3)
     return out
 
 
@@ -1460,7 +1509,8 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
                      codec: bool = True, sharded: bool = False) -> dict:
     """One engine configuration: ``world`` thread-ranks allreducing a
     ``n_elems`` fp32 buffer, over LocalFabric or localhost TCP (paced
-    to emulate the DCN wire). ``fill`` < 1 draws power-law sparse
+    to emulate the DCN wire); ``transport="shm"`` wraps the TCP mesh
+    in the co-located shared-memory rings (runtime/shm.py). ``fill`` < 1 draws power-law sparse
     inputs (pareto magnitudes on a random support, the MA-delta wire
     shape); ``codec=False`` disables the wire codec — the dense-RAW
     baseline an MA round shipping full parameters pays; ``sharded``
@@ -1479,7 +1529,7 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
     set_flag("wire_codec", codec)
     nets = []
     try:
-        if transport == "tcp":
+        if transport in ("tcp", "shm"):
             from multiverso_tpu.runtime.tcp import TcpNet
             eps = [f"127.0.0.1:{free_listen_port()}"
                    for _ in range(world)]
@@ -1488,6 +1538,12 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
             # the real error, not a NameError from the finally.
             for r in range(world):
                 nets.append(TcpNet(r, eps))
+            if transport == "shm":
+                from multiverso_tpu.runtime.shm import ShmNet
+                nets = [ShmNet(n) for n in nets]
+                for n in nets:
+                    n.enable_shm(0x6B3A, [r for r in range(world)
+                                          if r != n.rank])
         else:
             fabric = LocalFabric(world)
             nets = [fabric.endpoint(r) for r in range(world)]
@@ -1543,7 +1599,7 @@ def _allreduce_world(world: int, algo: str, pace_mbps: float,
                     engines[0].last_reduce_state_bytes / 1e6, 3)}
     finally:
         # Flag restore is structural now (@flag_guarded).
-        if transport == "tcp":
+        if transport in ("tcp", "shm"):
             for n in nets:
                 n.finalize()
 

@@ -71,6 +71,18 @@ echo "== zero-copy wire path subset (golden frames / buffer pool / COW) =="
 # already ran in the mvlint block above.
 python -m pytest tests/test_zero_copy.py -x -q
 
+echo "== shm transport subset (co-located rings / lifecycle hygiene / interop) =="
+# The below-the-socket transport gets its own named gate: ring round
+# trips land as read-only views INTO the shared segment, bounded
+# backpressure on a saturated ring, the weakref slot-parking contract,
+# oversize chunking through the receive pool, -chaos_frames coverage
+# of ring sends, segment unlink on finalize/SIGKILL/rejoin (a
+# /dev/shm entry or resource_tracker warning surviving a test is a
+# failure), and the mixed shm+TCP 3-process byte-identity proof
+# (tests/test_shm.py; docs/MEMORY.md "Below the socket"). The static
+# half — copy-lint over runtime/shm.py — ran in the mvlint block.
+python -m pytest tests/test_shm.py -x -q
+
 echo "== sparse-allreduce subset (index-union reduce / switchover / sharded avg) =="
 # The sparse collective tier gets its own named gate: choose_algo path
 # pinning per (size, density, world), index-union merge correctness vs

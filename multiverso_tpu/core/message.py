@@ -134,6 +134,14 @@ class MsgType(enum.IntEnum):
     # mvlint pass 6).
     Control_Reply_Config = 43
     Control_Config = -43
+    # Shared-memory transport announce (runtime/shm.py, docs/MEMORY.md
+    # "Below the socket"): the sender of a freshly created shm ring
+    # segment tells the receiver to attach, carrying int64
+    # [nonce, token]. Controller band by VALUE, but intercepted below
+    # the communicator (ShmNet.recv consumes it before routing ever
+    # sees it) — it rides TCP so it orders after every frame already
+    # queued toward the destination, fencing the transport switch.
+    Control_Shm_Announce = 44
 
 HEADER_SIZE = 10  # ints (8 in the reference; slot 8 added for
 #                   replication, slot 9 for request tracing)

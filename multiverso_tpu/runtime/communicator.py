@@ -204,6 +204,12 @@ class Communicator(Actor):
         # toward peers that ADVERTISED it during registration.
         self._codec = (not self._net.in_process
                        and bool(get_flag("wire_codec")))
+        # Shm-transport probe (runtime/shm.py): frames toward a
+        # ring-routed peer skip the codec filter — compressing below
+        # the socket buys no syscalls or kernel copies, so the codec
+        # CPU is pure loss there (the codec is lossless by default, so
+        # results are identical either way).
+        self._shm_probe = getattr(self._net, "is_shm_peer", None)
         # Per-destination dispatch queues (wire transports only):
         # server-bound requests to different destinations must not
         # serialize behind each other on this actor's one thread.
@@ -299,7 +305,9 @@ class Communicator(Actor):
                 if blob.on_device:
                     jax.block_until_ready(blob.data)
         if self._codec and \
-                self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC:
+                self._zoo.peer_caps(msg.dst) & CAP_WIRE_CODEC and \
+                not (self._shm_probe is not None
+                     and self._shm_probe(msg.dst)):
             encode_message(msg)
         try:
             # Reached from the DISPATCH loop only when the transport is

@@ -29,6 +29,7 @@ Fault-tolerance extensions (absent in the reference, SURVEY.md 5.3):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -418,11 +419,16 @@ class Controller(Actor):
             # pair with a ghost.
             self._barrier_waiting = [m for m in self._barrier_waiting
                                      if m.src != msg.src]
-            table, counts, caps = self._node_reply
+            table, counts, caps, host_ids, token = self._node_reply
             reply = msg.create_reply_message()
             reply.push(Blob(table.copy()))
             reply.push(Blob(counts.copy()))
             reply.push(Blob(caps.copy()))
+            # Frozen shm-negotiation blobs (runtime/shm.py): the SAME
+            # token keeps segment names stable across a rejoin, so
+            # survivors' announce/attach state stays coherent.
+            reply.push(Blob(host_ids.copy()))
+            reply.push(Blob(token.copy()))
             self.send_to(actors.COMMUNICATOR, reply)
             # Re-anchor the rejoined rank (and any lagging worker) on
             # the CURRENT shard maps: its snapshot restored the
@@ -445,12 +451,17 @@ class Controller(Actor):
         # Wire-capability word per rank (register blob int 2; absent on
         # pre-codec peers, which therefore stay at 0 = passthrough).
         caps = np.zeros(self._zoo.net_size, dtype=np.int32)
+        # Host fingerprint per rank (register blob int 3; -1 = unknown,
+        # never matches): the shm transport's co-location detector.
+        host_ids = np.full(self._zoo.net_size, -1, dtype=np.int32)
         for request in self._register_waiting:
             reg = request.data[0].as_array(np.int32)
             rank, role = int(reg[0]), int(reg[1])
             nodes[rank].role = role
             if reg.size >= 3:
                 caps[rank] = int(reg[2])
+            if reg.size >= 4:
+                host_ids[rank] = int(reg[3])
         num_workers = num_servers = 0
         for node in nodes:
             if is_worker(node.role):
@@ -463,12 +474,21 @@ class Controller(Actor):
             [[n.rank, n.role, n.worker_id, n.server_id] for n in nodes],
             dtype=np.int32)
         counts = np.array([num_workers, num_servers], dtype=np.int32)
-        self._node_reply = (table, counts, caps)
+        # Cluster-wide shm segment-naming token, chosen ONCE and frozen
+        # with the reply: rejoining ranks get the same value, so ring
+        # segment names (mvshm-{token}-{src}-{dst}, runtime/shm.py)
+        # stay consistent for the life of the cluster.
+        token = np.array(
+            [int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF],
+            dtype=np.int32)
+        self._node_reply = (table, counts, caps, host_ids, token)
         for request in self._register_waiting:
             reply = request.create_reply_message()
             reply.push(Blob(table.copy()))
             reply.push(Blob(counts.copy()))
             reply.push(Blob(caps.copy()))
+            reply.push(Blob(host_ids.copy()))
+            reply.push(Blob(token.copy()))
             self.send_to(actors.COMMUNICATOR, reply)
         self._register_waiting = []
 

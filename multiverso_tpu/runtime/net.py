@@ -16,8 +16,11 @@ provide:
   tests — without needing MPI.
 - Multi-host deployment maps to ``jax.distributed`` + one LocalFabric per
   host; cross-host tensor traffic is XLA-over-DCN inside the jitted step,
-  so a cross-host control transport is only needed for table RPC (a TCP
-  message-stream backend implementing this same interface — planned).
+  so a cross-host control transport is only needed for table RPC: the TCP
+  message-stream backend (``tcp.py``) implements this interface, and
+  ``shm.py`` wraps it so frames between same-host peers travel through
+  per-pair shared-memory rings instead of kernel loopback (negotiated per
+  peer at registration; docs/MEMORY.md "Below the socket").
 
 Messages are delivered whole (no serialization needed in-process; device
 arrays ride inside Blobs with zero copies).

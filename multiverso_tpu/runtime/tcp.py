@@ -644,6 +644,13 @@ class TcpNet(NetInterface):
             return None
         return item
 
+    def deliver(self, msg: Message) -> None:
+        """Inject a locally received message into the inbox — the
+        delivery port of the shm ring poller (runtime/shm.py), so
+        ring-borne and socket-borne frames share one queue and recv
+        keeps its blocking semantics and per-source FIFO."""
+        self._inbox.push(msg)
+
     def finalize(self) -> None:
         with self._lifecycle:
             if self._closed:

@@ -83,6 +83,8 @@ THREAD_ROLES = {
     "runtime/tcp.py::_PeerWriter._main": WRITER,
     "runtime/tcp.py::TcpNet._accept_main": BACKGROUND,
     "runtime/tcp.py::TcpNet._reader_main": BACKGROUND,
+    "runtime/shm.py::_ShmPeerWriter._main": WRITER,
+    "runtime/shm.py::ShmNet._poll_main": BACKGROUND,
     "runtime/metrics.py::MetricsReporter._main": BACKGROUND,
     "runtime/snapshot.py::SnapshotManager._main": BACKGROUND,
     "runtime/autotune.py::AutotuneManager._main": BACKGROUND,

@@ -41,6 +41,11 @@ from .communicator import Communicator
 from .controller import Controller
 from .net import LocalFabric, NetInterface, PeerLostError
 from .server import Server, backup_worker_count
+# Imported eagerly so the -shm* flag definitions are registered before
+# Zoo.start parses the command line (same reason as the admission
+# import above): a lazy import inside _maybe_wrap_shm would register
+# them only *after* parse_cmd_flags has already discarded -shm=0.
+from . import shm as _shm
 from .tcp import TcpNet, take_pending_net
 from .worker import Worker
 
@@ -256,10 +261,20 @@ class Zoo:
         (ref: zmq_net.h:25-61), else the single-rank in-process default."""
         pending = take_pending_net()
         if pending is not None:
-            return pending
+            return self._maybe_wrap_shm(pending)
         if get_flag("machine_file"):
-            return TcpNet.from_flags()
+            return self._maybe_wrap_shm(TcpNet.from_flags())
         return LocalFabric(1).endpoint(0)
+
+    @staticmethod
+    def _maybe_wrap_shm(net: NetInterface) -> NetInterface:
+        """Layer the shared-memory ring transport over a TCP mesh when
+        ``-shm`` is on (runtime/shm.py): co-located peers negotiate
+        per-pair rings at registration; everything else stays TCP."""
+        if (bool(get_flag("shm")) and _shm.supported()
+                and isinstance(net, TcpNet)):
+            return _shm.ShmNet(net)
+        return net
 
     def _start_ps(self) -> None:
         role = int(role_from_string(self._role_override
@@ -305,13 +320,20 @@ class Zoo:
     def _register_node(self, role: int) -> None:
         from ..util.wire_codec import CAP_WIRE_CODEC
         caps = CAP_WIRE_CODEC if get_flag("wire_codec") else 0
+        shm_ok = (bool(get_flag("shm"))
+                  and hasattr(self._net, "enable_shm"))
+        if shm_ok:
+            caps |= _shm.CAP_SHM
         msg = Message(src=self.rank, dst=CONTROLLER_RANK,
                       msg_type=MsgType.Control_Register)
         # Third int advertises wire capabilities (codec negotiation);
-        # a controller that only reads [:2] still registers this rank,
+        # the fourth a host fingerprint (shm co-location detection).
+        # A controller that only reads [:2] still registers this rank,
         # it just never learns the capability — which degrades to
-        # passthrough, the safe direction.
-        msg.push(Blob(np.array([self.rank, role, caps], dtype=np.int32)))
+        # passthrough/TCP, the safe direction.
+        msg.push(Blob(np.array([self.rank, role, caps,
+                                _shm.host_fingerprint()],
+                               dtype=np.int32)))
         self.send_to(actors.COMMUNICATOR, msg)
         reply = self._pop_control()
         assert reply is not None and reply.type == MsgType.Control_Reply_Register
@@ -330,6 +352,21 @@ class Zoo:
             self._peer_caps = reply.data[2].as_array(np.int32).copy()
         else:
             self._peer_caps = np.zeros(self.net_size, dtype=np.int32)
+        # Shm negotiation (reply blobs 3+4, runtime/shm.py): the
+        # controller's per-rank host-id vector plus the cluster-wide
+        # segment-naming token. Peers on MY host that advertised
+        # CAP_SHM become ring-send targets; an older controller (or a
+        # -shm=0 cluster) simply never ships the blobs — TCP stays.
+        if shm_ok and len(reply.data) >= 5:
+            host_ids = reply.data[3].as_array(np.int32)
+            token = int(reply.data[4].as_array(np.int32)[0])
+            me = _shm.host_fingerprint()
+            peers = [r for r in range(self.net_size)
+                     if r != self.rank and r < len(host_ids)
+                     and int(host_ids[r]) == me
+                     and self.peer_caps(r) & _shm.CAP_SHM]
+            if peers:
+                self._net.enable_shm(token, peers)
         log.debug("Rank %d registered: workers=%d servers=%d caps=%s",
                   self.rank, self._num_workers, self._num_servers,
                   self._peer_caps.tolist())

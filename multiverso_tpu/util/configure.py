@@ -40,6 +40,11 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "zero_copy": True,
     "buffer_pool_mb": 32,
     "buffer_pool_classes": 12,
+    # -- shared-memory transport for co-located ranks (runtime/shm.py;
+    #    docs/MEMORY.md "Below the socket") --
+    "shm": True,
+    "shm_ring_slots": 16,
+    "shm_slot_kb": 512,
     "ps_role": "default",
     "ma": False,
     "sync": False,

@@ -65,6 +65,26 @@ METRIC_NAMES: Dict[str, str] = {
     "POOL_MISS": "receive-frame leases that allocated fresh",
     "POOL_RESIDENT_KB": "buffer-pool retained free bytes (KB) at "
                         "each return",
+    # -- shared-memory transport (runtime/shm.py; docs/MEMORY.md
+    #    "Below the socket") --
+    "shm_send": "ring-slot copy of one outbound frame (the shm data "
+                "path's single copy)",
+    "shm_recv": "in-place parse (or chunk reassembly) of one "
+                "ring-borne frame",
+    "SHM_FRAMES": "frames sent through shm rings",
+    "SHM_BYTES": "frame bytes sent through shm rings",
+    "SHM_RING_FULL_WAITS": "ring-full backpressure episodes on shm "
+                           "writer threads (slow-reader signal)",
+    "SHM_CHUNKED_FRAMES": "frames larger than one ring slot, streamed "
+                          "as CONT chunks",
+    "SHM_BYTES_COPIED": "bytes copied out of ring slots reassembling "
+                        "chunked frames (single-slot frames parse in "
+                        "place and count nothing here)",
+    "SHM_SLOT_PARKED": "ring slots parked because a Blob view "
+                       "outlived its message (freed on re-probe)",
+    "SHM_PIN_COPIES": "frames copied off the ring because consumer-"
+                      "held frames pinned half the slots (the anti-"
+                      "deadlock pressure valve)",
     # -- client cache (tables/client_cache.py) --
     "CLIENT_CACHE_HIT": "cache lookups served locally",
     "CLIENT_CACHE_MISS": "cache lookups that crossed the wire",

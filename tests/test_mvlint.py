@@ -274,8 +274,8 @@ class TestCleanTree:
         messages = "\n".join(v.message for v in found)
         assert "ghost.py" in messages                 # doc-only row
         assert "core/blob.py" in messages             # missing row
-        # both directions fire: 1 stale + 6 missing modules
-        assert len(found) == 7
+        # both directions fire: 1 stale + 7 missing modules
+        assert len(found) == 8
 
     def test_doc_drift_is_a_violation(self, tmp_path):
         drifted = tmp_path / "WIRE_FORMAT.md"

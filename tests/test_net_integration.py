@@ -285,7 +285,7 @@ mv.init(flags)
 zoo = mv.current_zoo()
 from multiverso_tpu.util.wire_codec import CAP_WIRE_CODEC
 assert zoo.peer_caps(0) & CAP_WIRE_CODEC, zoo._peer_caps
-assert zoo.peer_caps(1) == 0, zoo._peer_caps
+assert not zoo.peer_caps(1) & CAP_WIRE_CODEC, zoo._peer_caps
 matrix = mv.create_matrix_table(64, 33, is_sparse=True)
 if rank == 0:
     delta = np.zeros((3, 33), np.float32)

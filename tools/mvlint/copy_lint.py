@@ -41,6 +41,7 @@ WIRE_PATH_MODULES = (
     "multiverso_tpu/core/blob.py",
     "multiverso_tpu/core/message.py",
     "multiverso_tpu/runtime/tcp.py",
+    "multiverso_tpu/runtime/shm.py",
     "multiverso_tpu/runtime/communicator.py",
     "multiverso_tpu/runtime/allreduce_engine.py",
     "multiverso_tpu/util/wire_codec.py",

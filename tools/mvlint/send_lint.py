@@ -39,6 +39,7 @@ from .framework import LintPass, ModuleInfo, Violation
 ALLOWED_SUFFIXES = (
     "multiverso_tpu/runtime/net.py",
     "multiverso_tpu/runtime/tcp.py",
+    "multiverso_tpu/runtime/shm.py",
     "multiverso_tpu/runtime/communicator.py",
     "multiverso_tpu/runtime/allreduce_engine.py",
 )
