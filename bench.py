@@ -2794,7 +2794,19 @@ if table is not None:
     t = threading.Thread(target=trainer, daemon=True)
     t.start()
     print("READY", serving_port, flush=True)
-    sys.stdin.readline()
+    while True:
+        line = sys.stdin.readline()
+        if line.startswith("SAMPLE"):
+            # Self-reported thread census for the many-connection arm
+            # (Python 3.10 does not propagate thread names to /proc
+            # comm, so the parent cannot count roles from outside).
+            from multiverso_tpu.runtime import thread_roles as tr
+            alive = tr.roles_alive()
+            print("THREADS", threading.active_count(),
+                  alive.get(tr.EVENTLOOP, 0) + alive.get(tr.WRITER, 0),
+                  flush=True)
+            continue
+        break
     stop.set()
     t.join(timeout=10)
     print("ADDS", adds[0], flush=True)
@@ -3159,6 +3171,235 @@ def run_serving_fleet(tmp: str) -> dict:
     return out
 
 
+_MANYCONN_CLIENT = '''
+import json, os, socket, sys, time
+import selectors
+
+port, n_conns, reqs_per_conn, window = (int(v) for v in sys.argv[1:5])
+REQ = (b"GET /v1/tables/emb/rows?ids=1,5,9,13 HTTP/1.1\\r\\n"
+       b"Host: 127.0.0.1\\r\\nConnection: keep-alive\\r\\n\\r\\n")
+
+# Phase 1: establish every keep-alive connection up front (sequential
+# blocking dials on loopback are ~0.1 ms each and never overflow the
+# accept backlog). The pump itself is ONE thread + one selector.
+socks = []
+for _ in range(n_conns):
+    s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    s.setblocking(False)
+    socks.append(s)
+fd_count = len(os.listdir("/proc/self/fd"))
+print("CONNECTED", len(socks), fd_count, flush=True)
+sys.stdin.readline()  # parent samples the frontend /proc, then acks
+
+# Phase 2: single-threaded selectors pump. Each connection answers
+# reqs_per_conn requests; at most `window` are in flight at once so
+# the other ~500 connections sit ESTABLISHED-idle — the C10k shape the
+# event-loop transport exists for. One request outstanding per
+# connection, so a read buffer never holds more than one response.
+sel = selectors.DefaultSelector()
+state = {}  # sock -> [buf, t0, remaining]
+for s in socks:
+    state[s] = [b"", 0.0, reqs_per_conn]
+idle = list(socks)
+out = {"lat": [], "served": 0, "shed": 0, "errors": 0, "inflight_window": window}
+total = n_conns * reqs_per_conn
+done = 0
+inflight = 0
+t_start = time.perf_counter()
+deadline = t_start + 600
+while done < total and time.perf_counter() < deadline:
+    while idle and inflight < window:
+        s = idle.pop()
+        st = state[s]
+        st[0] = b""
+        st[1] = time.perf_counter()
+        assert s.send(REQ) == len(REQ)  # 80 B into an empty buffer
+        sel.register(s, selectors.EVENT_READ)
+        inflight += 1
+    for key, _ in sel.select(timeout=10):
+        s = key.fileobj
+        st = state[s]
+        try:
+            data = s.recv(65536)
+        except BlockingIOError:
+            continue
+        if not data:  # server hung up mid-exchange
+            sel.unregister(s)
+            s.close()
+            st[2] = 0
+            done += 1
+            inflight -= 1
+            out["errors"] += 1
+            continue
+        st[0] += data
+        head_end = st[0].find(b"\\r\\n\\r\\n")
+        if head_end < 0:
+            continue
+        head = st[0][:head_end].decode("latin-1")
+        clen = 0
+        for line in head.split("\\r\\n")[1:]:
+            if line.lower().startswith("content-length:"):
+                clen = int(line.split(":", 1)[1])
+        if len(st[0]) < head_end + 4 + clen:
+            continue
+        status = int(head.split(None, 2)[1])
+        if status == 200:
+            out["lat"].append((time.perf_counter() - st[1]) * 1e3)
+            out["served"] += 1
+        elif status in (429, 503):
+            out["shed"] += 1
+        else:
+            out["errors"] += 1
+        sel.unregister(s)
+        done += 1
+        inflight -= 1
+        st[2] -= 1
+        if st[2] > 0:
+            idle.append(s)
+out["elapsed"] = time.perf_counter() - t_start
+out["completed"] = done
+out["total"] = total
+for s in socks:
+    s.close()
+print("CLIENTRES " + json.dumps(out), flush=True)
+'''
+
+
+def run_many_connections(tmp: str, n_conns: int = 512,
+                         reqs_per_conn: int = 4,
+                         window: int = 48) -> dict:
+    """Many-connection arm (docs/THREADS.md event-loop core): >= 512
+    keep-alive HTTP clients held open against ONE frontend rank by a
+    single-threaded selectors pump, with a bounded in-flight window so
+    most connections sit established-idle — the C10k shape. Records
+    QPS and p99 over the served requests plus the frontend's fd count
+    and TRANSPORT thread count sampled from /proc while every
+    connection is up. Acceptance: all n_conns connections concurrently
+    established, and transport threads O(1) — the selector loop plus
+    the (peer-count-bounded, connection-count-independent) shm ring
+    writers — while total fds scale with connections."""
+    from multiverso_tpu.util.net_util import free_listen_port
+
+    mf = os.path.join(tmp, "manyconn_mf.txt")
+    with open(mf, "w") as f:
+        for p in (free_listen_port(), free_listen_port()):
+            f.write(f"127.0.0.1:{p}\n")
+    serving_port = free_listen_port()
+    code = _FLEET_CHILD.format(
+        repo=os.path.dirname(os.path.abspath(__file__)), mf=mf,
+        num_row=4096, num_col=32)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    for rank, role, port in ((0, "server", 0),
+                             (1, "worker", serving_port)):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code, str(rank), "2", role,
+             str(port), "-max_get_staleness=16"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env))
+    out = {"n_conns": n_conns, "reqs_per_conn": reqs_per_conn}
+    try:
+        for p in procs:
+            while True:
+                line = p.stdout.readline()
+                if not line:
+                    p.wait(timeout=30)
+                    raise RuntimeError(
+                        f"manyconn child exited rc={p.returncode}: "
+                        f"{p.stderr.read()[-400:]}")
+                if line.startswith("READY"):
+                    break
+        client = subprocess.Popen(
+            [sys.executable, "-c", _MANYCONN_CLIENT,
+             str(serving_port), str(n_conns), str(reqs_per_conn),
+             str(window)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env)
+        try:
+            line = client.stdout.readline()
+            if not line.startswith("CONNECTED"):
+                raise RuntimeError(
+                    f"manyconn client failed to connect: "
+                    f"{client.stderr.read()[-400:]}")
+            _, connected, client_fds = line.split()
+            out["connected"] = int(connected)
+            out["client_fd_count"] = int(client_fds)
+            # Every connection is established and held right now —
+            # fd census from /proc, thread census self-reported by the
+            # frontend over its stdin/stdout pipe (thread ROLES are
+            # not visible from outside the process).
+            fe = procs[1]
+            try:
+                out["frontend_fd_count"] = len(
+                    os.listdir(f"/proc/{fe.pid}/fd"))
+            except OSError:
+                out["frontend_fd_count"] = None
+            fe.stdin.write("SAMPLE\n")
+            fe.stdin.flush()
+            out["frontend_threads_total"] = None
+            out["frontend_transport_threads"] = None
+            while True:
+                line = fe.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("THREADS"):
+                    _, total, transport = line.split()
+                    out["frontend_threads_total"] = int(total)
+                    out["frontend_transport_threads"] = int(transport)
+                    break
+            client.stdin.write("\n")
+            client.stdin.flush()
+            cout, cerr = client.communicate(timeout=700)
+        except Exception:
+            client.kill()
+            client.communicate()
+            raise
+        if client.returncode:
+            raise RuntimeError(f"manyconn client failed: {cerr[-400:]}")
+        doc = None
+        for line in cout.splitlines():
+            if line.startswith("CLIENTRES "):
+                doc = json.loads(line[10:])
+        if doc is None:
+            raise RuntimeError(
+                f"manyconn client printed no result: {cout[-200:]}")
+    finally:
+        for p in procs:
+            try:
+                p.stdin.write("\n")
+                p.stdin.flush()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs:
+            try:
+                p.communicate(timeout=120)
+            except Exception:  # noqa: BLE001
+                p.kill()
+                p.communicate()
+    lat = sorted(doc.pop("lat"))
+
+    def pick(p):
+        return round(lat[min(int(len(lat) * p / 100),
+                             len(lat) - 1)], 3) if lat else None
+
+    out.update(
+        served=doc["served"], shed=doc["shed"],
+        errors=doc["errors"], completed=doc["completed"],
+        elapsed_s=round(doc["elapsed"], 3),
+        qps=round(doc["completed"] / max(doc["elapsed"], 1e-9), 1),
+        p50_ms=pick(50), p99_ms=pick(99),
+        inflight_window=doc["inflight_window"],
+        accept_512_keepalive_connections=bool(
+            out["connected"] >= 512
+            and (out["frontend_fd_count"] or 0) >= 512),
+        # O(1): one selector loop + at most one shm ring writer per
+        # CO-LOCATED RANK (here: 1), never a thread per connection.
+        accept_o1_transport_threads=bool(
+            out["frontend_transport_threads"] is not None
+            and out["frontend_transport_threads"] <= 4))
+    return out
+
+
 def matrix_bandwidth() -> dict:
     import jax.numpy as jnp
 
@@ -3441,6 +3682,7 @@ _PHASE_EST = {
     "wire_codec": 15, "zero_copy": 45, "client_cache": 45,
     "allreduce": 260,
     "observability": 60, "elastic": 110, "autotune": 120,
+    "many_connections": 90,
 }
 
 
@@ -3748,6 +3990,11 @@ def main() -> None:
     fleet = result.run("serving_fleet", run_serving_fleet, tmp)
     if fleet:
         result.merge(serving_fleet=fleet)
+
+    manyconn = result.run("many_connections", run_many_connections,
+                          tmp)
+    if manyconn:
+        result.merge(many_connections=manyconn)
 
     matrix = result.run("matrix_bandwidth", matrix_bandwidth)
     if matrix:

@@ -145,6 +145,18 @@ echo "== roles subset (thread-role registry / blocking watchdog / call graph) ==
 # in the mvlint block above. docs/THREADS.md.
 python -m pytest tests/test_thread_roles.py tests/test_callgraph.py -x -q
 
+echo "== event-loop transport subset (peer state machines / O(1) threads) =="
+# The selector-loop transport core gets its own named gate: every peer
+# state transition (CONNECTING -> HANDSHAKE -> READY -> DRAINING ->
+# DEAD) driven over real loopback sockets, nonblocking connect backoff
+# against a not-yet-bound listener, the connect-deadline typed failure,
+# the idle-EOF quiet retire + same-endpoint rejoin, goodbye-draining
+# finalize with a peer dying mid-drain, and the O(1)-threads-in-peers
+# invariant. The conftest leak guard additionally asserts around EVERY
+# test in the repo that role-thread and fd counts return to baseline
+# (tests/test_event_loop.py; docs/THREADS.md).
+python -m pytest tests/test_event_loop.py -x -q
+
 echo "== obs subset (tracing / metrics export / scrape surface) =="
 # Observability invariants get their own named gate: trace-id sampling
 # and wire propagation (TRACE_SLOT, byte-identity when off), the span

@@ -452,9 +452,10 @@ class AutotuneManager:
         return cur, "hold", "repair rate nominal"
 
     def _policy_coalesce(self, cur, sig):
-        """Back off the coalescing flush caps while dispatch queues
-        sit deep (staged adds behind a deep queue only add latency);
-        restore toward the canonical default when shallow."""
+        """Back off the coalescing flush caps while outbound send
+        queues sit deep (staged adds behind a deep queue only add
+        latency); restore toward the canonical default when
+        shallow."""
         depth = sig["queue_p90"]
         default = CANONICAL_FLAGS["coalesce_max_msgs"]
         if depth > QUEUE_DEEP and cur > 8:

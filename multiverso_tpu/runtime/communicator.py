@@ -2,19 +2,25 @@
 
 TPU-native equivalent of the reference's ``Communicator``
 (ref: include/multiverso/communicator.h:11-28, src/communicator.cpp:31-107).
-The in-process transport is thread-safe (THREAD_MULTIPLE in reference
-terms), so this uses the reference's ZMQ shape: the actor thread handles
-outbound traffic while a separate receive thread drains the net endpoint
-(ref: src/communicator.cpp:42-48,77-91). Inbound and loop-back messages are
-routed to the right local actor by message type — requests to the server,
-replies to the worker, control requests to the controller, control replies
-to the Zoo mailbox (ref: src/communicator.cpp:13-29,93-105).
+The reference gives the communicator its own actor thread because its ZMQ
+sockets are single-threaded; this port's transports are thread-safe, and
+outbound frames land in per-destination queues drained by the transport's
+event loop — so there is no communicator thread to serialize behind.
+``receive`` routes ON THE CALLER'S THREAD: a remote-bound message is
+encoded and submitted to its destination's peer queue right there (the
+queue's ``-send_queue_mb`` cap is the backpressure, felt by the producer
+that is actually overrunning the wire), and a loop-back message is
+forwarded to the right local actor by message type — requests to the
+server, replies to the worker, control requests to the controller,
+control replies to the Zoo mailbox (ref: src/communicator.cpp:13-29,
+93-105). One dedicated receive thread drains the net endpoint
+(ref: src/communicator.cpp:42-48,77-91); it is the only thread this
+class owns.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 import numpy as np
@@ -25,176 +31,27 @@ from ..core.message import (PEER_LOST_MARK, Message, MsgType,
                             is_controller_bound, is_server_bound,
                             is_wire_encoded, is_worker_bound, mark_error,
                             trace_of)
-from ..util import log, mt_queue, tracing
-from ..util.configure import define_bool, get_flag
-from ..util.dashboard import samples
-from ..util.lock_witness import named_condition, named_lock
-from ..util.mt_queue import MtQueue
+from ..util import log, tracing
+from ..util.configure import get_flag
 from ..util.wire_codec import (CAP_WIRE_CODEC, decode_message,
                                encode_message)
 from . import actor as actors
 from . import thread_roles
-from .actor import Actor
-
-define_bool("dispatch_queues", True,
-            "per-destination dispatch queues for server-bound traffic "
-            "over wire transports: each destination rank gets its own "
-            "encode+send thread, so one slow or hot server no longer "
-            "head-of-line-blocks requests to its siblings behind the "
-            "communicator's single outbound thread (docs/SHARDING.md). "
-            "Per-destination FIFO — add-before-get order per "
-            "connection — is preserved; in-process fabrics skip the "
-            "queues (send is a mailbox push, there is no line to block)")
 
 
-class _DispatchQueues:
-    """Per-destination outbound queues + threads (wire transports only).
+class Communicator:
+    """Message router between this rank's actors and the transport.
 
-    The communicator actor's single thread serializes codec-encode and
-    socket writes ACROSS destinations: with several servers, backpressure
-    or a long frame toward one destination delays every other server's
-    traffic (the ISSUE-7 head-of-line block). Server-bound requests are
-    instead handed to a per-destination thread that does the encode and
-    the (possibly blocking) send for just that peer. Per-destination
-    FIFO is preserved — everything to one dst flows through one queue —
-    which is the only order the protocol relies on (add-before-get per
-    connection). Queue depth and dispatch latency are recorded per
-    destination (``DISPATCH_QUEUE_DEPTH[d*]`` / ``DISPATCH_MS[d*]``
-    sample reservoirs) so the bench can localize a hot server."""
-
-    def __init__(self, comm: "Communicator"):
-        self._comm = comm
-        # _queues is NOT guarded_by-annotated: submit()'s lock-free
-        # first probe (double-checked creation) reads it off-lock on
-        # purpose — a GIL-atomic dict.get whose miss re-checks under
-        # the lock.
-        self._queues: dict = {}
-        self._threads: list = []  # guarded_by: _lock
-        self._lock = named_lock(  # lazy per-dst creation
-            f"communicator.dispatchq[r{comm._zoo.rank}]")
-        # Byte-bounded, like TcpNet's async writer queues one layer
-        # down: the old actor-thread blocking send WAS the backpressure
-        # for server-bound traffic, and an unbounded queue would let a
-        # caller looping fire-and-forget adds against one slow/paced
-        # peer buffer payload bytes without limit. submit() blocks the
-        # communicator actor while a destination is over budget —
-        # under overload only, which is exactly the old behavior.
-        self._cap_bytes = max(int(get_flag("send_queue_mb", 32)), 1) << 20
-        self._queued_bytes: dict = {}  # guarded_by: _drained
-        self._drained = named_condition(
-            f"communicator.dispatchq[r{comm._zoo.rank}].drained",
-            self._lock)
-
-    @staticmethod
-    def _nbytes(msg: Message) -> int:
-        return sum(int(b.size) for b in msg.data) + 64
-
-    def submit(self, msg: Message) -> None:
-        dst = msg.dst
-        queue = self._queues.get(dst)
-        if queue is None:
-            with self._lock:
-                queue = self._queues.get(dst)
-                if queue is None:
-                    queue = MtQueue(
-                        f"dispatchq[r{self._comm._zoo.rank}->d{dst}]")
-                    # WRITER role: blocking on the wire toward one
-                    # destination is this thread's whole purpose.
-                    thread = thread_roles.spawn(
-                        thread_roles.WRITER,
-                        target=self._main, args=(dst, queue),
-                        name=f"mv-dispatch-r{self._comm._zoo.rank}-d{dst}")
-                    self._queues[dst] = queue
-                    self._threads.append(thread)
-        nbytes = self._nbytes(msg)
-        with self._drained:
-            # Block until the destination is under budget — the same
-            # backpressure the old blocking actor-thread send provided.
-            # NO cap-busting escape hatch: a paced wire legitimately
-            # takes minutes to drain a large frame (bytes / pace_mbps),
-            # so a timeout override would silently re-open the
-            # unbounded-buffering hole exactly when pacing makes it
-            # easiest to hit. The drainer thread cannot die with work
-            # queued (its send errors are caught and routed), so this
-            # wait always ends; the periodic log just makes a long
-            # stall observable.
-            while self._queued_bytes.get(dst, 0) > self._cap_bytes:
-                if not self._drained.wait(timeout=30.0):
-                    log.info("dispatch queue d%d: still over budget "
-                             "after 30s (%d bytes queued) — waiting "
-                             "for the paced wire to drain", dst,
-                             self._queued_bytes.get(dst, 0))
-            self._queued_bytes[dst] = \
-                self._queued_bytes.get(dst, 0) + nbytes
-        depth = queue.size()
-        samples(f"DISPATCH_QUEUE_DEPTH[d{dst}]").add(depth)
-        tid = trace_of(msg)
-        if tid:  # untraced messages (the default) pay one int check
-            tracing.event(tid, "dispatch_enqueue",
-                          self._comm._zoo.rank,
-                          args={"dst": dst, "depth": depth})
-        queue.push((time.perf_counter(),
-                    tracing.now_ns() if tid else 0, nbytes, msg))
-
-    def _main(self, dst: int, queue: MtQueue) -> None:
-        lat = samples(f"DISPATCH_MS[d{dst}]")
-        while True:
-            item = queue.pop()
-            if item is None:
-                break
-            queued_at, queued_ns, nbytes, msg = item
-            if queued_ns:  # sampled (nonzero only when enqueue traced)
-                # Dequeue span: the time this frame spent waiting in
-                # the per-destination queue (queue-vs-wire attribution
-                # in the merged trace).
-                tracing.add_span(trace_of(msg), "dispatch_queue_wait",
-                                 self._comm._zoo.rank, queued_ns,
-                                 tracing.now_ns() - queued_ns,
-                                 args={"dst": dst})
-            try:
-                self._comm._encode_and_send(msg)
-            except Exception:  # noqa: BLE001 - _encode_and_send already
-                # routed the failure (synthesized error reply /
-                # peer_lost); the queue must keep draining for the
-                # other messages.
-                log.error("dispatch queue d%d: send failed", dst)
-            with self._drained:
-                self._queued_bytes[dst] = \
-                    self._queued_bytes.get(dst, 0) - nbytes
-                self._drained.notify_all()
-            lat.add((time.perf_counter() - queued_at) * 1e3)
-
-    def stop(self) -> None:
-        """Drain-exit: queued frames still flush (MtQueue.pop returns
-        buffered items after exit), then the threads finish."""
-        for queue in list(self._queues.values()):
-            queue.exit()
-        # Snapshot under the lock: a submit() racing shutdown could
-        # append a writer while this loop iterates the list.
-        with self._lock:
-            threads = list(self._threads)
-        for thread in threads:
-            thread.join(timeout=30)
-
-    def depths(self) -> dict:
-        return {dst: q.size() for dst, q in self._queues.items()}
-
-
-class Communicator(Actor):
-    #: The dispatch loop is latency-critical: every control/liveness
-    #: frame in the process rides it. mvlint pass 9 proves no blocking
-    #: primitive is reachable from it.
-    ROLE = thread_roles.DISPATCH
+    Deliberately NOT an ``Actor``: it owns no mailbox and no dispatch
+    thread. The old single communicator thread was the repo's most
+    persistent failure class (dispatch starvation behind a dead or slow
+    peer), and the per-destination WRITER threads that cured it cost
+    O(peers) threads; both collapsed into the transport's event loop,
+    leaving ``receive`` a plain synchronous call."""
 
     def __init__(self, zoo) -> None:
-        super().__init__(actors.COMMUNICATOR, zoo)
-        # Outbound pressure observable next to the server/worker
-        # mailboxes (MAILBOX_DEPTH[*] family, docs/SERVING.md),
-        # gated like theirs: the communicator mailbox is the hottest
-        # queue in the process, and a training-only run must not pay
-        # a reservoir append per message for samples nobody reads.
-        if mt_queue.depth_sampling_enabled():
-            self.mailbox.track_depth("MAILBOX_DEPTH[communicator]")
+        self.name = actors.COMMUNICATOR
+        self._zoo = zoo
         self._net = zoo.net
         self._recv_thread: Optional[threading.Thread] = None
         # Filter stage: encode only over a real wire (in-process blobs
@@ -210,33 +67,22 @@ class Communicator(Actor):
         # CPU is pure loss there (the codec is lossless by default, so
         # results are identical either way).
         self._shm_probe = getattr(self._net, "is_shm_peer", None)
-        # Per-destination dispatch queues (wire transports only):
-        # server-bound requests to different destinations must not
-        # serialize behind each other on this actor's one thread.
-        self._queues = _DispatchQueues(self) \
-            if (not self._net.in_process
-                and bool(get_flag("dispatch_queues"))) else None
+        zoo.register_actor(self)
 
     def start(self) -> None:
-        super().start()
         self._net.acquire_recv_owner()
-        # DISPATCH too: the recv thread routes inbound frames into
-        # actor mailboxes — anything blocking it starves replies.
+        # DISPATCH: the recv thread routes inbound frames into actor
+        # mailboxes — anything blocking it starves replies.
         self._recv_thread = thread_roles.spawn(
             thread_roles.DISPATCH, target=self._recv_main,
             name=f"mv-comm-recv-r{self._zoo.rank}")
 
     def stop(self, finalize_net: bool = True) -> None:
-        # Drain-exit the actor thread BEFORE closing the transport: replies
-        # the controller queued for remote ranks may not have hit the wire
-        # yet, and finalizing first silently drops them — the peer then
-        # hangs forever in its final barrier. (LocalNet's direct in-process
-        # delivery masks this; a real wire transport does not.)
-        super().stop()
-        if self._queues is not None:
-            # The actor drain may have pushed frames into the queues;
-            # they must hit the wire before the transport closes.
-            self._queues.stop()
+        # Callers route straight into the transport, so there is no
+        # actor mailbox to drain first: any reply another actor queued
+        # is already sitting in a peer queue, and finalize flushes
+        # those (goodbye-after-traffic) before closing — the peer's
+        # final barrier still gets its frames.
         if finalize_net:
             self._net.finalize()
         else:
@@ -244,41 +90,48 @@ class Communicator(Actor):
         if self._recv_thread is not None:
             self._recv_thread.join(timeout=30)
         self._net.release_recv_owner()
+        self._zoo.deregister_actor(self)
 
     def queue_depths(self) -> dict:
-        """Live per-destination dispatch queue depths (bench/monitor
-        observability; empty when the queues are off)."""
-        return self._queues.depths() if self._queues is not None else {}
+        """Live per-destination outbound queue depths (bench/monitor
+        observability; empty on transports without peer queues)."""
+        return getattr(self._net, "queue_depths", lambda: {})()
 
-    # Outbound path: actor mailbox -> wire (or loop back locally); every
-    # message type goes through the same route-or-send dispatch. The
-    # codec filter stage runs here — per message, gated on the PEER's
-    # advertised capability so a passthrough peer keeps getting plain
-    # frames (mixed-version clusters stay correct, merely uncompressed).
+    # -- messaging (zoo.route/send_to call this like any actor's) --
+    def receive(self, msg: Message) -> None:
+        self._safe_dispatch(msg)
+
+    def _safe_dispatch(self, msg: Message) -> None:
+        """Dispatch one message; a routing failure must not kill the
+        calling actor's loop (same contract as Actor._safe_dispatch)."""
+        try:
+            self._dispatch(msg)
+        except Exception:  # noqa: BLE001
+            log.error("actor %s: handling message type %d raised",
+                      self.name, msg.type_int)
+            import traceback
+            traceback.print_exc()
+
+    # Outbound path: caller's thread -> wire (or loop back locally);
+    # every message type goes through the same route-or-send dispatch.
+    # The codec filter stage runs here — per message, gated on the
+    # PEER's advertised capability so a passthrough peer keeps getting
+    # plain frames (mixed-version clusters stay correct, merely
+    # uncompressed).
     def _dispatch(self, msg: Message) -> None:
         if msg.dst != self._zoo.rank:
-            if self._queues is not None:
-                # ALL remote traffic rides the destination's own
-                # queue thread (WRITER role), not just server-bound
-                # requests: a reply or control frame doing a blocking
-                # wire send from THIS thread would starve every frame
-                # behind it — the PR-6/9/12 class pass 9 now proves
-                # away. Per-destination FIFO still holds: everything
-                # toward one dst flows through one queue.
-                self._queues.submit(msg)
-                return
             self._encode_and_send(msg)
         else:
             self._local_forward(msg)
 
     def _encode_and_send(self, msg: Message) -> None:
-        """Outbound tail shared by the actor thread and the dispatch
-        queue threads: settle in-process device payloads, run the codec
-        filter for capable peers, send, and route any transport failure
-        into the synthesized-error path. The chaos harness's frame
-        faults (-chaos_frames, util/chaos.py) hook HERE — one
-        message-level choke point for every communicator-routed frame
-        on either transport; a dropped frame counts as sent."""
+        """Outbound tail: settle in-process device payloads, run the
+        codec filter for capable peers, submit to the destination's
+        peer queue, and route any transport failure into the
+        synthesized-error path. The chaos harness's frame faults
+        (-chaos_frames, util/chaos.py) hook HERE — one message-level
+        choke point for every communicator-routed frame on either
+        transport; a dropped frame counts as sent."""
         faulted = chaos.filter_frames(msg)
         if faulted is not None:
             for m in faulted:
@@ -310,12 +163,12 @@ class Communicator(Actor):
                      and self._shm_probe(msg.dst)):
             encode_message(msg)
         try:
-            # Reached from the DISPATCH loop only when the transport is
-            # in-process (send = mailbox push, non-blocking) or when
-            # -dispatch_queues is explicitly off — the documented
-            # legacy direct-backpressure mode; wire deployments route
-            # through the WRITER queue threads above.
-            self._net.send(msg)  # mvlint: ignore[thread-role]
+            # send_async: enqueue on the destination's peer state
+            # machine and return. The call blocks only under that
+            # peer's -send_queue_mb backpressure (timed waits), never
+            # on a socket; a peer already marked dead raises the
+            # parked PeerLostError immediately.
+            self._net.send_async(msg)
         except Exception as exc:  # noqa: BLE001 - a dead peer must
             # not strand the requester's waiter (the actor loop
             # would only log): synthesize the error reply the peer

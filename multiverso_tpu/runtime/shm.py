@@ -7,8 +7,11 @@ and two kernel copies per frame. This module finishes the job: frames
 between co-located ranks travel through a per-directed-pair ring of
 fixed slots in POSIX shared memory (``multiprocessing.shared_memory``),
 written by the sender's writer thread and consumed in place by the
-receiver — **one** ``memoryview`` copy total (producer side, into the
-slot) and **zero** syscalls on the data path.
+receiver's event loop — **one** ``memoryview`` copy total (producer
+side, into the slot) and **zero** syscalls on the data path. A doorbell
+FIFO per receiver (one nonblocking byte after each frame, drained on
+the selector) replaces the old busy-polling consumer thread: an idle
+pair costs a parked ``selector.select``, not CPU.
 
 Architecture (see docs/MEMORY.md "Below the socket"):
 
@@ -58,8 +61,8 @@ a frame that fits one slot is parsed in place —
 shared slot, with a :class:`_SlotLease` riding the Blobs. When the
 last Blob dies the lease checks its *weak references* to the frame's
 backing numpy arrays; a survivor (a user-held view pins its base
-array) makes the slot *park* instead of freeing (the poller
-re-probes), so a blob outliving everything can never alias a recycled
+array) makes the slot *park* instead of freeing (the ring
+service re-probes), so a blob outliving everything can never alias a recycled
 slot. A blob outliving the whole segment is safe too: ``shm.close()``
 with live exports raises ``BufferError`` and the mapping moves to a
 module graveyard instead of unmapping.
@@ -82,6 +85,7 @@ from __future__ import annotations
 import atexit
 import collections
 import os
+import selectors
 import socket as _socket
 import struct
 import threading
@@ -181,6 +185,14 @@ def _seg_name(token: int, src: int, dst: int) -> str:
     return f"mvshm-{token & 0xFFFFFFFF:08x}-{src}-{dst}"
 
 
+def _bell_name(token: int, rank: int) -> str:
+    """Doorbell FIFO name for ``rank``'s receive side. The mvshm-
+    prefix keeps it inside the lifecycle-hygiene sweep (tests scan
+    /dev/shm for leftovers by that prefix), and ``_unlink_name``'s raw
+    shm_unlink removes /dev/shm entries regardless of file type."""
+    return f"mvshm-bell-{token & 0xFFFFFFFF:08x}-{rank}"
+
+
 def _untrack(shm) -> None:
     """Opt this mapping out of the multiprocessing resource tracker.
     The tracker would unlink every registered segment at interpreter
@@ -255,7 +267,7 @@ class _SlotLease:
     ``_deserialize_frame``). Every user-held view derives from one of
     those arrays and pins it through its ``base`` chain, so a dead
     weakref set proves no export survives. Release with a survivor
-    parks the slot (the poller re-probes) instead of freeing it, so a
+    parks the slot (the ring service re-probes) instead of freeing it, so a
     long-lived Blob never aliases a recycled slot."""
 
     __slots__ = ("_ring", "_slot", "_watch")
@@ -278,7 +290,7 @@ class _SlotLease:
             return  # idempotent
         if self.exports_alive():
             # A Blob array (or a user view pinning it) is still alive:
-            # the slot must not recycle under it. Park; the poller
+            # the slot must not recycle under it. Park; the ring service
             # frees it once the last weakref clears.
             ring._park(self._slot, self)
             return
@@ -453,7 +465,7 @@ class _OutRing:
 
 
 class _InRing:
-    """The receiver's half: attached by the poller when the announce
+    """The receiver's half: attached by the ring service when the announce
     arrives, consumed in place, closed (never unlinked — the creator
     owns the name) on retire."""
 
@@ -468,12 +480,12 @@ class _InRing:
         self._pay = [shm.buf[pay + i * slot_bytes:
                              pay + (i + 1) * slot_bytes]
                      for i in range(nslots)]
-        self._tail = 0  # next slot to consume (poller-thread only)
+        self._tail = 0  # next slot to consume (loop-thread only)
         self._lock = named_lock(f"shm.in[{name}]")
         self._closed = False  # guarded_by: _lock
         self._parked: List[Tuple[int, "_SlotLease"]] = []  # guarded_by: _lock
         self._inplace = 0  # outstanding in-place leases; guarded_by: _lock
-        self._chunk = None  # chunked-frame assembly lease (poller only)
+        self._chunk = None  # chunked-frame assembly lease (loop only)
         self._chunk_off = 0
 
     @classmethod
@@ -656,12 +668,17 @@ class _InRing:
 
 
 class _ShmPeerWriter:
-    """Per-destination ring writer thread + bounded frame queue — the
-    shm twin of ``tcp._PeerWriter`` (same queue discipline, same
-    -send_queue_mb backpressure, same parked-error contract). The ring
-    segment is created lazily on THIS thread at the first frame, and
-    the TCP-borne announce goes out just before it — so ring frames
-    can never overtake the pre-ring TCP stream."""
+    """Per-destination ring writer thread + bounded frame queue (same
+    queue discipline, -send_queue_mb backpressure, and parked-error
+    contract as the TCP transport's ``_Peer`` queues — but a dedicated
+    WRITER thread, because a full ring legitimately BLOCKS the producer
+    in ``_acquire_slot``'s spin, which the event loop must never do).
+    The ring segment is created lazily on THIS thread at the first
+    frame, and the TCP-borne announce goes out just before it — so
+    ring frames can never overtake the pre-ring TCP stream. After each
+    frame the writer rings the receiver's doorbell FIFO, which wakes
+    the peer's event loop out of ``selector.select`` — no busy-polling
+    consumer on the other side."""
 
     def __init__(self, net: "ShmNet", dst: int):
         self._net = net
@@ -747,6 +764,7 @@ class _ShmPeerWriter:
                 with monitor("shm_send"):
                     ring.write_frame(views, nbytes)
                 self._net._count_sent(nbytes)
+                self._net._ding(self._dst)
             except BaseException as exc:  # noqa: BLE001 - no caller to
                 # raise into: park the error, wake waiters — submit()
                 # and flush() turn it into PeerLostError.
@@ -767,6 +785,51 @@ class _ShmPeerWriter:
                 self._cond.notify_all()
 
 
+class _ShmBell:
+    """Receiver-side doorbell: a named FIFO in /dev/shm that senders
+    write one byte to after stamping a ring slot READY. Registered on
+    the inner TcpNet's event loop, so a co-located peer's frame wakes
+    this rank's loop out of ``selector.select`` — the ring consumer
+    went from a busy-polling BACKGROUND thread to an fd on the same
+    selector every socket lives on. The payload is meaningless; the
+    readiness edge is the signal, and a full FIFO just means a ding is
+    already pending."""
+
+    def __init__(self, net: "ShmNet", name: str):
+        self._net = net
+        self.name = name
+        path = "/dev/shm/" + name
+        try:
+            os.mkfifo(path)
+        except FileExistsError:
+            # Stale leftover from a SIGKILL'd predecessor of this rank
+            # (the rejoin path): reap it and claim the name.
+            _unlink_name(name)
+            os.mkfifo(path)
+        _created_names.add(name)  # atexit reap if we die before finalize
+        # O_RDWR (not O_RDONLY) on our own FIFO: the Linux trick that
+        # keeps one writer reference alive forever, so a sender closing
+        # its end can never leave the read side at EOF (a persistently
+        # readable fd would spin the selector).
+        self.fd = os.open(path, os.O_RDWR | os.O_NONBLOCK)
+
+    def on_misc_io(self, mask: int) -> None:
+        while True:
+            try:
+                chunk = os.read(self.fd, 4096)
+            except (BlockingIOError, OSError):
+                break
+            if not chunk:
+                break
+        self._net._ring_service()
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+
 class ShmNet(NetInterface):
     """A TcpNet wrapped with per-peer shared-memory rings for
     co-located ranks. Remote and non-shm peers, bootstrap, control
@@ -775,6 +838,7 @@ class ShmNet(NetInterface):
 
     def __init__(self, tcp: TcpNet):
         self._tcp = tcp
+        self._loop = tcp._loop  # ring service rides the TCP event loop
         rank = tcp.rank
         self._lifecycle = named_lock(f"shm[r{rank}].lifecycle")
         self._stats_lock = named_lock(f"shm[r{rank}].stats")
@@ -791,11 +855,20 @@ class ShmNet(NetInterface):
         self._shm_peers: frozenset = frozenset()
         self._ring_peers: set = set()
         self._announced: Dict[int, Tuple[int, int]] = {}  # src -> (nonce, token)
-        self._attached: Dict[int, _InRing] = {}  # poller-thread only
-        self._dead: set = set()  # srcs whose in-ring the poller must retire
+        self._attached: Dict[int, _InRing] = {}  # loop-thread only
+        self._dead: set = set()  # srcs whose in-ring the service must retire
         self._reaped: Dict[int, str] = {}  # dead peers' segment names
-        self._poller: Optional[threading.Thread] = None  # guarded_by: _lifecycle
-        self._poll_stop = False
+        self._reaped_bells: Dict[int, str] = {}  # dead peers' bell names
+        # Doorbell state. _bell and the service bookkeeping below are
+        # loop-thread only; _bell_fds maps dst -> cached O_WRONLY fd of
+        # the PEER's bell, touched by that dst's writer thread (and
+        # closed by drop_connection only after the writer is joined).
+        self._bell: Optional[_ShmBell] = None
+        self._bell_fds: Dict[int, int] = {}
+        self._attach_retry: Dict[int, float] = {}  # loop-thread only
+        self._svc_stopped = False  # loop-thread only
+        self._timer_armed = False  # loop-thread only
+        self._idle_delay = 0.001  # loop-thread only
 
     # -- NetInterface delegation --
     @property
@@ -843,6 +916,11 @@ class ShmNet(NetInterface):
         for p in mine:
             self._ring_peers.add(p)
         if mine:
+            # Kick the ring service so our doorbell FIFO exists before
+            # the first peer ding (a miss is covered by the fallback
+            # timer, but the bell makes delivery latency selector-fast
+            # from frame one).
+            self._loop.call_soon(self)
             log.info("shm transport enabled: rank %d ring-sends to %s "
                      "(token %08x)", self.rank, sorted(mine),
                      int(token) & 0xFFFFFFFF)
@@ -936,6 +1014,18 @@ class ShmNet(NetInterface):
             writer.flush(timeout)
         self._tcp.flush_sends(dst, timeout)
 
+    def queue_depths(self) -> Dict[int, int]:
+        """Outbound frames queued per destination, ring and TCP paths
+        combined (the same introspection port TcpNet exposes)."""
+        with self._lifecycle:
+            writers = list(self._writers.items())
+        depths = self._tcp.queue_depths()
+        for dst, writer in writers:
+            with writer._cond:
+                depths[dst] = depths.get(dst, 0) + len(writer._frames) \
+                    + (1 if writer._writing else 0)
+        return depths
+
     # -- receive path --
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         deadline = None if timeout is None \
@@ -970,65 +1060,121 @@ class ShmNet(NetInterface):
         if src in self._shm_peers:
             self._ring_peers.add(src)
         self._reaped.pop(src, None)  # it rejoined: nothing to reap
-        self._ensure_poller()
+        self._loop.call_soon(self)  # service attaches the new ring
 
-    def _ensure_poller(self) -> None:
-        with self._lifecycle:
-            if self._closed or self._poller is not None:
-                return
-            self._poller = thread_roles.spawn(
-                thread_roles.BACKGROUND, target=self._poll_main,
-                name=f"mv-shm-poll-r{self.rank}")
+    # -- ring service (event-loop thread) --
+    def on_misc_timer(self) -> None:
+        """Loop-job entry: announce kicks and enable_shm land here via
+        call_soon(self)."""
+        self._ring_service()
 
-    def _poll_main(self) -> None:
-        retry_at: Dict[int, float] = {}
-        spins = 0
-        while not self._poll_stop:
-            busy = False
-            # Attach newly announced (or re-announced after rejoin)
-            # rings. The announce postdates the create, so a miss
-            # means a dead peer or a superseded segment — retry with
-            # backoff until the announce table says otherwise.
-            for src, (nonce, _token) in list(self._announced.items()):
-                ring = self._attached.get(src)
-                if ring is not None and ring.nonce == nonce:
-                    continue
-                if ring is not None:  # peer rebuilt its segment
-                    self._attached.pop(src, None)
-                    ring.retire()
-                now = time.monotonic()
-                if now < retry_at.get(src, 0.0):
-                    continue
-                new = _InRing.attach(_seg_name(_token, src, self.rank),
-                                     nonce)
-                if new is None:
-                    retry_at[src] = now + 0.02
-                    continue
-                retry_at.pop(src, None)
-                self._attached[src] = new
-                busy = True
-            while self._dead:
-                src = self._dead.pop()
-                self._announced.pop(src, None)
-                ring = self._attached.pop(src, None)
-                if ring is not None:
-                    ring.retire()
-            for src, ring in list(self._attached.items()):
-                if ring.consume(self._tcp._pool, self._tcp.deliver):
-                    busy = True
-                ring.reprobe_parked()
-            if busy:
-                spins = 0
+    def _timer_fire(self) -> None:
+        self._timer_armed = False
+        self._ring_service()
+
+    def _ring_service(self) -> None:
+        """One service pass on the event loop — the old poller's loop
+        body: attach announced rings (with per-src backoff), retire
+        dead ones, consume READY frames in place, re-probe parked
+        slots. Normally woken by the doorbell FIFO; an adaptive
+        fallback timer (1ms busy, decaying to 50ms idle) covers what no
+        doorbell announces — attach retries, parked-slot lease deaths,
+        and dings lost before the bell existed."""
+        if self._svc_stopped:
+            return
+        busy = False
+        self._ensure_bell()
+        now = time.monotonic()
+        # Attach newly announced (or re-announced after rejoin) rings.
+        # The announce postdates the create, so a miss means a dead
+        # peer or a superseded segment — retry with backoff until the
+        # announce table says otherwise.
+        for src, (nonce, token) in list(self._announced.items()):
+            ring = self._attached.get(src)
+            if ring is not None and ring.nonce == nonce:
                 continue
-            spins += 1
-            if spins < 10:
-                # A fresh frame is usually one producer GIL slice away.
-                # Don't yield longer: in-process harnesses run producer
-                # and poller under ONE GIL, where busy-yielding steals
-                # the very slices the producer needs.
-                time.sleep(0)
-            else:
-                time.sleep(min(0.0001 * (spins - 9), 0.0005))
+            if ring is not None:  # peer rebuilt its segment
+                self._attached.pop(src, None)
+                ring.retire()
+            if now < self._attach_retry.get(src, 0.0):
+                continue
+            new = _InRing.attach(_seg_name(token, src, self.rank), nonce)
+            if new is None:
+                self._attach_retry[src] = now + 0.02
+                continue
+            self._attach_retry.pop(src, None)
+            self._attached[src] = new
+            busy = True
+        while self._dead:
+            src = self._dead.pop()
+            self._announced.pop(src, None)
+            ring = self._attached.pop(src, None)
+            if ring is not None:
+                ring.retire()
+        for src, ring in list(self._attached.items()):
+            if ring.consume(self._tcp._pool, self._tcp.deliver):
+                busy = True
+            ring.reprobe_parked()
+        self._idle_delay = 0.001 if busy \
+            else min(self._idle_delay * 2, 0.05)
+        if not self._timer_armed and (self._announced or self._attached
+                                      or self._dead):
+            self._timer_armed = True
+            self._loop.call_later(self._idle_delay, self._timer_fire)
+
+    def _ensure_bell(self) -> None:
+        if self._bell is not None:
+            return
+        with self._lifecycle:
+            token = self._token
+        if token is None:
+            # Receive side enabled by an inbound announce alone (our
+            # own enable_shm still in flight): any announced token IS
+            # the cluster token.
+            for _nonce, t in self._announced.values():
+                token = t
+                break
+        if token is None:
+            return
+        try:
+            bell = _ShmBell(self, _bell_name(token, self.rank))
+        except OSError:  # pragma: no cover - no FIFO support in
+            return  # /dev/shm: the fallback timer alone serves rings
+        self._bell = bell
+        self._loop.register(bell.fd, selectors.EVENT_READ, bell)
+
+    def _ding(self, dst: int) -> None:
+        """Writer-thread duty, right after a frame's slots flip READY:
+        one byte into the receiver's doorbell FIFO so its event loop
+        wakes now instead of at the next fallback tick. Every failure
+        mode is quietly survivable — the receiver's timer covers a
+        missing or torn-down bell, and a full FIFO means a ding is
+        already pending."""
+        fd = self._bell_fds.get(dst)
+        if fd is None:
+            with self._lifecycle:
+                token = self._token
+            if token is None:
+                return
+            path = "/dev/shm/" + _bell_name(token, dst)
+            try:
+                fd = os.open(path, os.O_WRONLY | os.O_NONBLOCK)
+            except OSError:
+                return  # bell not up (yet): ENXIO/ENOENT
+            self._bell_fds[dst] = fd
+        try:
+            os.write(fd, b"\0")
+        except BlockingIOError:
+            pass  # FIFO full: the pending ding covers this frame too
+        except OSError:
+            # Receiver closed its bell (teardown or rejoin): drop the
+            # cached fd so the next frame re-opens the new one.
+            stale = self._bell_fds.pop(dst, None)
+            if stale is not None:
+                try:
+                    os.close(stale)
+                except OSError:
+                    pass
 
     def interrupt_recv(self) -> None:
         self._tcp.interrupt_recv()
@@ -1047,9 +1193,21 @@ class ShmNet(NetInterface):
             writer = self._writers.pop(dst, None)
         if writer is not None:
             writer.retire(timeout=1.0)
-        self._dead.add(dst)  # poller retires the attached in-ring
+        # The writer is joined: its cached doorbell fd toward the dead
+        # peer is safe to close here, and the dead peer's bell name is
+        # recorded for the finalize reap (it never unlinks here — a
+        # rejoining replacement recreates the same name).
+        fd = self._bell_fds.pop(dst, None)
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         if ann is not None:
             self._reaped[dst] = _seg_name(ann[1], dst, self.rank)
+            self._reaped_bells[dst] = _bell_name(ann[1], dst)
+        self._dead.add(dst)  # the ring service retires the in-ring
+        self._loop.call_soon(self)
         self._tcp.drop_connection(dst)
 
     def finalize(self) -> None:
@@ -1057,7 +1215,6 @@ class ShmNet(NetInterface):
             already = self._closed
             self._closed = True
             writers, self._writers = dict(self._writers), {}
-            poller = self._poller
         if already:
             self._tcp.finalize()  # inner finalize is idempotent too
             return
@@ -1069,12 +1226,17 @@ class ShmNet(NetInterface):
             except (RuntimeError, PeerLostError):
                 pass
             writer.retire()
-        self._poll_stop = True
-        if poller is not None and poller is not threading.current_thread():
-            poller.join(timeout=5.0)
-        for ring in list(self._attached.values()):
-            ring.retire()
-        self._attached.clear()
+        # Writers are joined: the cached doorbell fds are dead weight.
+        for fd in list(self._bell_fds.values()):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._bell_fds.clear()
+        # Retire the attached rings and our own bell ON the loop (they
+        # are loop-thread state; the inner TcpNet is not finalized yet,
+        # so the loop is still serving).
+        self._loop.run_sync(self._teardown_rings, timeout=5.0)
         # Reap every inbound segment we know of — both the recorded
         # dead-peer names AND every announced name. A peer that died
         # without ever reaching drop_connection (the abort path raises
@@ -1085,10 +1247,39 @@ class ShmNet(NetInterface):
         # way, and unlink never invalidates an established mapping). A
         # leaked /dev/shm entry outliving the cluster is the one
         # failure mode the lifecycle-hygiene tests treat as fatal.
+        # Dead peers' doorbell FIFOs are reaped the same way.
         for src, (nonce, token) in list(self._announced.items()):
             _unlink_name(_seg_name(token, src, self.rank))
+            # The announcer's doorbell FIFO too: a SIGKILL'd peer (no
+            # atexit) reaches finalize via the abort path, which never
+            # calls drop_connection — without this the dead rank's
+            # bell outlives the cluster. Unlinking a LIVE peer's bell
+            # is as survivable as unlinking its segment: the owner
+            # keeps its O_RDWR fd, cached sender fds stay valid, and
+            # new opens fall back to the service timer.
+            _unlink_name(_bell_name(token, src))
         self._announced.clear()
         for name in self._reaped.values():
             _unlink_name(name)
         self._reaped.clear()
+        for name in self._reaped_bells.values():
+            _unlink_name(name)
+        self._reaped_bells.clear()
         self._tcp.finalize()
+
+    def _teardown_rings(self) -> None:
+        """Finalize, on the loop: stop the ring service, detach every
+        in-ring (live Blob views park mappings on the graveyard), and
+        retire the doorbell."""
+        self._svc_stopped = True
+        for ring in list(self._attached.values()):
+            ring.retire()
+        self._attached.clear()
+        bell, self._bell = self._bell, None
+        if bell is not None:
+            try:
+                self._loop.unregister(bell.fd)
+            except (KeyError, ValueError):
+                pass
+            bell.close()
+            _unlink_name(bell.name)

@@ -22,6 +22,17 @@ and no libzmq:
   materialize to host bytes at the wire boundary. ``-zero_copy=0``
   falls back to the flat join/copy path (byte-identical frames — the
   bench baseline and the mixed-build escape hatch);
+- all socket I/O — accepts, nonblocking connects, frame reads, frame
+  writes — multiplexes onto ONE ``selectors`` event-loop thread per
+  endpoint (``_EventLoop``). Each destination is a ``_Peer`` state
+  machine (CONNECTING → HANDSHAKE → READY → DRAINING → DEAD) with a
+  bounded outbound frame queue (``-send_queue_mb`` backpressure, same
+  contract the per-peer writer threads used to enforce); each inbound
+  connection is a ``_Conn`` read state machine filling the same pooled
+  lease buffers the old reader threads did. Transport thread count is
+  O(1) in peer count, a dead peer costs retry timers instead of a
+  blocked thread, and dead-peer detection unifies onto
+  selector-observed EOF/ECONNRESET plus the heartbeat path;
 - bootstrap is machine-file driven (one ``host[:port]`` per line, own rank
   found by local-address match or the ``-rank`` flag,
   ref: zmq_net.h:20-28,25-61) or app-driven via ``net_bind``/
@@ -35,10 +46,15 @@ inside a jitted step rides XLA collectives and never sees this layer.
 from __future__ import annotations
 
 import collections
+import errno
+import heapq
+import os
+import selectors
 import socket
 import struct
 import threading
 import time
+import traceback
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -49,9 +65,8 @@ from ..util import chaos, log, tracing
 from ..util.buffer_pool import BufferPool
 from ..util.configure import (define_bool, define_double, define_int,
                               define_string, get_flag)
-from ..util.dashboard import count, monitor
-from ..util.lock_witness import (acquire_timeout, named_condition,
-                                 named_lock)
+from ..util.dashboard import count, monitor, samples
+from ..util.lock_witness import named_condition, named_lock
 from ..util.mt_queue import MtQueue
 from ..util.net_util import local_addresses
 from . import thread_roles
@@ -70,8 +85,10 @@ define_double("connect_timeout_s", 30.0,
               "peer that is not (yet) listening — covers both bootstrap "
               "races and, with the fault-tolerance retry path, the "
               "restart window of a crashed peer (a send toward a dead "
-              "rank blocks in connect-retry until the replacement "
-              "process binds, then delivers)")
+              "rank waits in connect-retry until the replacement "
+              "process binds, then delivers). The retries are "
+              "nonblocking timers on the event loop: an unreachable "
+              "peer costs zero blocked threads")
 define_bool("zero_copy", True,
             "scatter-gather wire path: serialize outbound frames as "
             "view lists drained by sendmsg vectored writes (no flat "
@@ -82,19 +99,37 @@ define_bool("zero_copy", True,
             "baseline and a diagnostics escape hatch")
 define_double("net_pace_mbps", 0.0,
               "emulate a constrained wire: pace outbound frames to this "
-              "many megabits/s. The sleep happens BEFORE each write "
-              "while holding the destination's send lock, so a frame "
+              "many megabits/s. Each frame reserves its transmission "
+              "slot on a shared busy-until deadline and is held on an "
+              "event-loop timer until the slot opens, so a frame "
               "occupies the emulated wire for its transmission time and "
-              "its ARRIVAL is delayed accordingly — on the writer "
-              "thread for async sends (the caller keeps computing), on "
-              "the caller for blocking sends. Bench/test knob for "
-              "reproducing DCN-speed behavior on localhost; 0 = off")
+              "its ARRIVAL is delayed accordingly — no thread sleeps. "
+              "Bench/test knob for reproducing DCN-speed behavior on "
+              "localhost; 0 = off")
 
 _HDR = struct.Struct(f"<{HEADER_SIZE}i")
 _LEN = struct.Struct("<Q")
 _NBLOBS = struct.Struct("<I")
 
 _RECV_INTERRUPT = object()
+
+#: _Peer connection states (peer.state; NET_PEER_STATE[*] counts every
+#: transition). CONNECTING covers both "not dialed yet" and the timer
+#: wait between nonblocking connect retries; HANDSHAKE is a connect_ex
+#: in flight (EINPROGRESS, waiting for writability); DRAINING is READY
+#: with a goodbye frame queued behind the remaining traffic (finalize);
+#: DEAD peers are retired from the peer table — the next send toward
+#: that rank starts a fresh state machine.
+_ST_CONNECTING = "CONNECTING"
+_ST_HANDSHAKE = "HANDSHAKE"
+_ST_READY = "READY"
+_ST_DRAINING = "DRAINING"
+_ST_DEAD = "DEAD"
+
+#: connect_ex return codes that mean "in progress, wait for the
+#: selector" rather than "failed".
+_EX_PENDING = frozenset(
+    {errno.EINPROGRESS, errno.EWOULDBLOCK, errno.EAGAIN, errno.EALREADY})
 
 
 def _parse_endpoint(line: str, default_port: int) -> Tuple[str, int]:
@@ -177,11 +212,20 @@ def serialize_views(msg: Message) -> Tuple[List[memoryview], int]:
 #: Linux); a frame with more views loops.
 _IOV_CAP = 64
 
+#: Emulated-wire catch-up window (s): how far behind its busy-until
+#: timeline the pacing bucket lets a sender fall before slots anchor
+#: to wall time again (``_pace_reserve``). Absorbs ms-scale wake
+#: jitter without banking unbounded burst across idle gaps.
+_PACE_CREDIT_S = 0.005
+
 
 def _sendmsg_all(sock: socket.socket, views: List[memoryview]) -> None:
     """Drain ``views`` through vectored writes, handling partial sends
     (sendmsg may stop mid-view under backpressure). Views must be
-    non-empty (``serialize_views`` filters zero-length ones)."""
+    non-empty (``serialize_views`` filters zero-length ones). Blocking
+    -socket helper for out-of-loop senders (the shm announce path and
+    tests); ``_Peer._drain`` is the nonblocking event-loop twin of this
+    arithmetic."""
     i = 0
     off = 0
     n = len(views)
@@ -292,59 +336,485 @@ def _deserialize_frame(body: memoryview, lease) -> Message:
     return msg
 
 
-class _PeerWriter:
-    """Per-destination writer thread + bounded frame queue.
+class _EventLoop:
+    """One ``selectors``-based I/O loop thread per endpoint.
 
-    ``send_async`` enqueues frames here as ``(views, nbytes)`` pairs —
-    the scatter-gather view lists ``serialize_views`` built, drained by
-    vectored ``sendmsg`` writes through the shared per-destination
-    socket (under the same ``_out_locks[dst]`` the blocking path takes,
-    so async and sync frames never interleave mid-write). The views
-    alias the payload's own buffers until the write completes, which is
-    exactly the ``send_async`` contract (NetInterface: the caller must
-    not mutate a queued payload before ``flush_sends``). Backpressure:
-    ``submit`` blocks once ``-send_queue_mb`` of frame bytes — summed
-    view lengths — are queued, so a runaway producer degrades to the
-    blocking-send behavior instead of buffering without bound. A wire
-    error parks in ``error`` and is re-raised to the next submit/flush
-    (the writer thread has no caller to raise into)."""
+    Everything the transport does with a socket — accepting, the
+    nonblocking connect handshakes, frame reads, frame writes, retry
+    and pacing timers, the shm ring doorbell — runs as handlers on this
+    single EVENTLOOP thread. The pass-9 blocking-reachability proof
+    (tools/mvlint/role_lint.py) pins the contract: the ONLY call that
+    may park this thread is the ``selector.select(timeout)`` in
+    ``_main``; every handler runs against nonblocking fds and timed
+    waits, so no dead peer can ever strand the loop.
+
+    Three thread-safe entry points exist for the rest of the process:
+    ``call_soon(job)`` (enqueue a job and wake the loop), ``wake()``
+    (self-pipe), and ``run_sync(fn)`` (call_soon + bounded wait —
+    finalize uses it to run teardown ON the loop). ``call_later`` and
+    the selector registration helpers are loop-thread-only.
+
+    Jobs and timer payloads dispatch by object type — ``_Peer`` ticks,
+    handler objects with ``on_misc_timer`` (TcpNet housekeeping, the
+    shm ring service), or plain callables. The explicit isinstance
+    chain is deliberate: it keeps every hot dispatch target statically
+    resolvable for the blocking-reachability proof (a single dynamic
+    ``job()`` would hide the transport behind an opaque call)."""
+
+    def __init__(self, rank: int):
+        self._rank = rank
+        self._sel = selectors.DefaultSelector()
+        self._pending: collections.deque = collections.deque()  # guarded_by: _pending_lock
+        self._pending_lock = named_lock(f"tcp[r{rank}].loop.pending")
+        self._timers: list = []  # heap of (when, seq, job); loop-thread only
+        self._tseq = 0
+        # Racy-by-design wake gate: worst case is one redundant
+        # self-pipe byte; the loop resets it before draining jobs so a
+        # racing call_soon can never be missed.
+        self._woken = False
+        self._stopped = False
+        self._fds_closed = False
+        rfd, wfd = os.pipe()
+        os.set_blocking(rfd, False)
+        os.set_blocking(wfd, False)
+        self._rfd, self._wfd = rfd, wfd
+        self._sel.register(rfd, selectors.EVENT_READ, _WakePipe(rfd))
+        self._tick_gauge = samples("EVENTLOOP_TICK_MS")
+        self._ready_gauge = samples("EVENTLOOP_READY_FDS")
+        self._thread = thread_roles.spawn(
+            thread_roles.EVENTLOOP, target=self._main,
+            name=f"mv-net-loop-r{rank}")
+
+    # -- thread-safe entry points --
+    def on_loop(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    def wake(self) -> None:
+        if self._woken:
+            return
+        self._woken = True
+        try:
+            os.write(self._wfd, b"\0")
+        except OSError:
+            pass  # pipe full (a wake is already pending) or torn down
+
+    def call_soon(self, job) -> None:
+        """Enqueue ``job`` for the next loop iteration (any thread)."""
+        with self._pending_lock:
+            self._pending.append(job)
+        self.wake()
+
+    def run_sync(self, fn, timeout: float = 5.0) -> bool:
+        """Run ``fn`` on the loop and wait (bounded) for it to finish.
+        Runs inline when called from the loop itself or after the loop
+        thread has exited (teardown stragglers must still run)."""
+        if self.on_loop() or not self._thread.is_alive():
+            fn()
+            return True
+        done = threading.Event()
+
+        def job():
+            try:
+                fn()
+            finally:
+                done.set()
+
+        self.call_soon(job)
+        return done.wait(timeout=timeout)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stopped = True
+        self.wake()
+        if not self.on_loop():
+            self._thread.join(timeout=timeout)
+        if not self._thread.is_alive() and not self._fds_closed:
+            self._fds_closed = True
+            try:
+                self._sel.close()
+            except OSError:
+                pass
+            for fd in (self._rfd, self._wfd):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+    # -- loop-thread-only helpers --
+    def call_later(self, delay: float, job) -> None:
+        self._tseq += 1
+        heapq.heappush(self._timers,
+                       (time.monotonic() + max(0.0, delay),
+                        self._tseq, job))
+
+    def register(self, fileobj, events: int, data) -> None:
+        self._sel.register(fileobj, events, data)
+
+    def modify(self, fileobj, events: int, data) -> None:
+        self._sel.modify(fileobj, events, data)
+
+    def unregister(self, fileobj) -> None:
+        self._sel.unregister(fileobj)
+
+    # -- the loop --
+    def _dispatch_job(self, job) -> None:
+        try:
+            if isinstance(job, _Peer):
+                job.on_peer_timer()
+            elif hasattr(job, "on_misc_timer"):
+                # Housekeeping handler objects (TcpNet gauge tick, the
+                # shm ring service) — object dispatch, so the blocking
+                # proof can resolve the targets.
+                job.on_misc_timer()
+            else:
+                job()  # plain callable (call_soon/run_sync closures)
+        except Exception:  # noqa: BLE001 - a handler bug must not take
+            # the whole transport's I/O loop down with it
+            log.error("event loop r%d: job %r raised:\n%s",
+                      self._rank, job, traceback.format_exc())
+
+    def _main(self) -> None:
+        sel = self._sel
+        select_errors = 0
+        while True:
+            timeout = None
+            if self._timers:
+                timeout = max(0.0, self._timers[0][0] - time.monotonic())
+                if timeout > 0.0015:
+                    # epoll ceils its wait to whole milliseconds, so a
+                    # timer parked for exactly `timeout` wakes up to
+                    # 1 ms LATE — and the pacing bucket's busy-until
+                    # arithmetic accumulates that drift per frame. Aim
+                    # one quantum early; the residual re-select lands
+                    # on time. (Sub-1.5 ms waits keep the ceil: a 0-
+                    # timeout here would busy-spin the core instead.)
+                    timeout -= 0.001
+            with self._pending_lock:
+                if self._pending:
+                    timeout = 0.0
+            try:
+                # The ONLY blocking call an EVENTLOOP thread may make
+                # (pass-9 pins this; the -debug_locks watchdog reads a
+                # thread parked here as idle because this is the entry
+                # frame).
+                events = sel.select(timeout)
+            except OSError:
+                # An fd died under the selector (should be unreachable:
+                # every close is preceded by unregister). Log and keep
+                # serving; bail if it persists so a bug cannot hot-spin.
+                select_errors += 1
+                if select_errors > 100:
+                    raise
+                log.error("event loop r%d: select failed:\n%s",
+                          self._rank, traceback.format_exc())
+                events = []
+            if self._stopped:
+                return
+            t0 = time.perf_counter()
+            worked = bool(events)
+            self._woken = False
+            jobs = None
+            with self._pending_lock:
+                if self._pending:
+                    jobs = list(self._pending)
+                    self._pending.clear()
+            if jobs:
+                worked = True
+                for job in jobs:
+                    self._dispatch_job(job)
+            now = time.monotonic()
+            while self._timers and self._timers[0][0] <= now:
+                _when, _seq, job = heapq.heappop(self._timers)
+                worked = True
+                self._dispatch_job(job)
+            for key, mask in events:
+                data = key.data
+                try:
+                    if isinstance(data, _Peer):
+                        data.on_peer_io(mask)
+                    elif isinstance(data, _Conn):
+                        data.on_conn_io(mask)
+                    elif isinstance(data, _Listener):
+                        data.on_accept_io(mask)
+                    else:
+                        data.on_misc_io(mask)
+                except Exception:  # noqa: BLE001 - ditto: one broken
+                    # handler must not stop every other fd's service
+                    log.error("event loop r%d: handler %r raised:\n%s",
+                              self._rank, data, traceback.format_exc())
+            if events:
+                self._ready_gauge.add(len(events))
+            if worked:
+                self._tick_gauge.add((time.perf_counter() - t0) * 1e3)
+
+
+class _WakePipe:
+    """Self-pipe read end: drains wake bytes so the selector can park
+    again. The payload is meaningless — the readiness edge is the
+    signal."""
+
+    def __init__(self, rfd: int):
+        self._rfd = rfd
+
+    def on_misc_io(self, mask: int) -> None:
+        while True:
+            try:
+                chunk = os.read(self._rfd, 4096)
+            except (BlockingIOError, OSError):
+                return
+            if not chunk:
+                return
+
+
+class _Listener:
+    """Accept handler: the listening socket is nonblocking and
+    registered on the loop; each accepted connection becomes a
+    ``_Conn`` read state machine on the same selector (the old model
+    spawned a blocking reader thread per connection here)."""
+
+    def __init__(self, net: "TcpNet"):
+        self._net = net
+
+    def on_accept_io(self, mask: int) -> None:
+        while True:
+            try:
+                conn, _addr = self._net._listener.accept()  # mvlint: ignore[thread-role] - nonblocking listener: EAGAIN ends the burst, never parks the loop
+            except BlockingIOError:
+                return
+            except OSError:
+                return  # listener closed (finalize)
+            conn.setblocking(False)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._net._register_conn(_Conn(self._net, conn))
+
+
+class _Conn:
+    """One inbound connection's receive state machine (loop-thread
+    only). Buffers and protocol are exactly the old reader thread's:
+    an 8-byte length prefix, then either a pooled lease filled by
+    ``recv_into`` (zero-copy) or a legacy bytearray (``-zero_copy=0``);
+    a length-0 frame is the peer's goodbye (graceful close), EOF
+    without one is a dirty close and reports the peer. The difference
+    is shape: the fill tolerates partial reads and resumes whenever the
+    selector reports readability instead of parking a thread in
+    ``recv``."""
+
+    #: Frames parsed per readiness event before yielding the loop
+    #: (level-triggered epoll re-arms, so a firehose connection gets
+    #: re-served next tick without starving the other fds).
+    _FRAME_BUDGET = 32
+
+    def __init__(self, net: "TcpNet", sock: socket.socket):
+        self._net = net
+        self._sock: Optional[socket.socket] = sock
+        self._head = memoryview(bytearray(_LEN.size))
+        self._head_got = 0
+        self._total = 0
+        self._lease = None  # pooled frame lease (zero-copy path)
+        self._legacy: Optional[bytearray] = None  # -zero_copy=0 path
+        self._body: Optional[memoryview] = None  # fill target
+        self._body_got = 0
+        self._t0_ns = 0
+        self.peer: Optional[int] = None  # rank learned from frames
+
+    def on_conn_io(self, mask: int) -> None:
+        if self._sock is None:
+            return  # stale event: torn down earlier in this batch
+        try:
+            self._read_burst()
+        except BlockingIOError:
+            pass  # socket drained mid-frame; resumes on next readiness
+        except OSError:
+            self._close(clean=False)
+
+    def _read_burst(self) -> None:
+        frames = 0
+        while frames < self._FRAME_BUDGET:
+            if self._body is None:
+                # Header phase: accumulate the 8-byte length prefix.
+                k = self._sock.recv_into(self._head[self._head_got:])  # mvlint: ignore[thread-role] - nonblocking fd: EAGAIN raises, never parks
+                if k == 0:
+                    self._close(clean=False)  # EOF without goodbye
+                    return
+                self._head_got += k
+                if self._head_got < _LEN.size:
+                    continue
+                (total,) = _LEN.unpack(self._head)
+                self._head_got = 0
+                if total == 0:  # goodbye frame: graceful peer close
+                    self._close(clean=True)
+                    return
+                self._total = total
+                self._t0_ns = tracing.now_ns()
+                if bool(get_flag("zero_copy")):
+                    self._lease = self._net._pool.lease(total)
+                    self._body = self._lease.view(total)
+                else:
+                    self._legacy = bytearray(total)
+                    self._body = memoryview(self._legacy)
+                self._body_got = 0
+            # Body phase: progressive fill of the leased buffer.
+            with monitor("tcp_recv"):
+                k = self._sock.recv_into(self._body[self._body_got:])  # mvlint: ignore[thread-role] - nonblocking fd: EAGAIN raises, never parks
+            if k == 0:
+                self._close(clean=False)  # EOF mid-frame
+                return
+            self._body_got += k
+            if self._body_got < self._total:
+                continue
+            self._finish_frame()
+            frames += 1
+
+    def _finish_frame(self) -> None:
+        total = self._total
+        lease, self._lease = self._lease, None
+        legacy, self._legacy = self._legacy, None
+        self._body = None
+        self._total = 0
+        with monitor("tcp_deserialize"):
+            if legacy is None:
+                msg = _deserialize_frame(lease.view(total), lease)
+            else:
+                msg = _deserialize(legacy)
+        tid = trace_of(msg)
+        if tid:
+            # The trace id is only known after the parse; the span
+            # still covers the read+deserialize window.
+            tracing.add_span(tid, "tcp_recv", self._net.rank,
+                             self._t0_ns, tracing.now_ns() - self._t0_ns,
+                             args={"bytes": total})
+        # Every inbound frame names its sender; remembering it lets a
+        # dirty close report WHICH peer died (the zoo's rejoin path
+        # fails only that rank's in-flight requests instead of aborting
+        # the whole cluster).
+        if 0 <= msg.src < self._net.size and msg.src != self._net.rank:
+            self.peer = msg.src
+        self._net._inbox.push(msg)
+
+    def close_for_teardown(self) -> None:
+        self._close(clean=True)
+
+    def _close(self, clean: bool) -> None:
+        if self._sock is None:
+            return
+        self._net._unregister_conn(self)
+        sock, self._sock = self._sock, None
+        try:
+            sock.close()
+        except OSError:
+            pass
+        lease, self._lease = self._lease, None
+        self._body = None
+        self._legacy = None
+        if lease is not None:
+            lease.release()  # mid-frame teardown: recycle the buffer
+        # Racy teardown check by design: worst case is one spurious
+        # peer-lost report during finalize, which abort ignores.
+        if not clean and not self._net._closed:  # mvlint: ignore[guarded-by]
+            # A peer hung up while the mesh is live: report it so the
+            # zoo can abort blocked waits (the reference has no such
+            # detection — a dead MPI rank hangs the cluster).
+            self._net._conn_died(self.peer)
+
+
+class _Peer:
+    """Per-destination connection state machine + bounded outbound
+    frame queue (CONNECTING → HANDSHAKE → READY → DRAINING → DEAD).
+
+    Replaces the per-destination writer THREAD: ``submit`` enqueues
+    ``(views, nbytes)`` scatter-gather frames under the same
+    ``-send_queue_mb`` backpressure contract, and the event loop drains
+    them with nonblocking ``sendmsg`` vectored writes — partial-send
+    resume included — so the views alias the payload's own buffers
+    until the write completes (the ``send_async`` contract: callers
+    must not mutate a queued payload before ``flush_sends``). A wire
+    error parks in ``error`` and re-raises from the next submit/flush
+    as ``PeerLostError``; the dead machine retires itself from the peer
+    table, so the next send toward this rank dials fresh.
+
+    Locking: the queue fields are caller-shared under ``_cond``;
+    everything about the socket and connection state is loop-thread
+    only."""
+
+    #: Frames written per drain pass before yielding the loop (WRITE
+    #: readiness re-kicks immediately; the budget just interleaves
+    #: other fds' service between bursts — and keeps the watchdog's
+    #: same-line stack heuristic from mistaking a long burst for a
+    #: parked thread).
+    _DRAIN_FRAMES = 64
+
+    #: Pacing burst slack (s): epoll timers have ~1 ms granularity, so
+    #: parking for a sub-millisecond pace gap wakes late and the
+    #: chunked pipelines bleed a timer-quantum per frame. A frame due
+    #: within this window sends immediately instead — the token
+    #: bucket's absolute busy-until arithmetic keeps the long-run rate
+    #: exact, this only trades ms-scale smoothness (the old sleeping
+    #: writer's overshoot, in the other direction).
+    _PACE_SLACK = 0.002
 
     def __init__(self, net: "TcpNet", dst: int):
         self._net = net
+        self._loop = net._loop
         self._dst = dst
-        self._cond = named_condition(f"tcp[r{net.rank}].writer[d{dst}]")
+        self._cond = named_condition(f"tcp[r{net.rank}].peer[d{dst}]")
         self._frames: collections.deque = collections.deque()  # guarded_by: _cond
         self._queued_bytes = 0  # guarded_by: _cond
-        self._writing = False  # guarded_by: _cond
-        self._closed = False  # guarded_by: _cond
+        self._inflight = False  # guarded_by: _cond
+        self._kicked = False  # guarded_by: _cond
         self.error: Optional[BaseException] = None  # guarded_by: _cond
-        self._thread = thread_roles.spawn(
-            thread_roles.WRITER, target=self._main,
-            name=f"mv-tcp-write-r{net.rank}-d{dst}")
+        self.closed = False  # guarded_by: _cond
+        # Loop-thread-only connection state:
+        self.state = _ST_CONNECTING
+        self._sock: Optional[socket.socket] = None
+        self._registered = False
+        self._want_write = False
+        self._cur: Optional[list] = None  # [views, i, off, nbytes, t0, bye]
+        self._pace_until = 0.0
+        self._deadline = 0.0  # connect-epoch deadline (0 = not dialing)
+        self._retry_at = 0.0
+        self._retry_delay = 0.02
+        self._eof_scratch = memoryview(bytearray(256))
+        self._depth_gauge = samples(f"DISPATCH_QUEUE_DEPTH[d{dst}]")
+        self._lat_gauge = samples(f"DISPATCH_MS[d{dst}]")
+        count(f"NET_PEER_STATE[{_ST_CONNECTING}]")
 
-    def submit(self, views: List[memoryview], nbytes: int) -> None:
+    # -- caller-side API (any thread) --
+    def submit(self, views: List[memoryview], nbytes: int,
+               goodbye: bool = False) -> None:
         cap = max(1, int(get_flag("send_queue_mb"))) << 20
+        # The loop itself must never park on backpressure (it IS the
+        # drain); loop-side submits (the finalize goodbye) enqueue
+        # unconditionally.
+        on_loop = self._loop.on_loop()
+        kick = False
         with self._cond:
-            while (self._queued_bytes >= cap and self.error is None
-                   and not self._closed):
+            while (not on_loop and self._queued_bytes >= cap
+                   and self.error is None and not self.closed):
                 self._cond.wait(timeout=1.0)
             if self.error is not None:
-                # The endpoint is DEAD (the writer thread died on it):
-                # typed so callers can tell a lost peer — retryable
-                # after a rejoin — from a local programming error.
+                # The endpoint is DEAD: typed so callers can tell a
+                # lost peer — retryable after a rejoin — from a local
+                # programming error.
                 raise PeerLostError(
                     f"send to rank {self._dst} failed: peer connection "
                     f"is dead ({self.error})") from self.error
-            if self._closed:
+            if self.closed and not goodbye:
                 raise RuntimeError("TcpNet finalized")
-            self._frames.append((views, nbytes))
+            self._frames.append(
+                (views, nbytes, time.perf_counter(), goodbye))
             self._queued_bytes += nbytes
+            depth = len(self._frames)
+            if not self._kicked:
+                self._kicked = True
+                kick = True
             self._cond.notify_all()
+        self._depth_gauge.add(depth)
+        if kick:
+            self._loop.call_soon(self)
 
     def flush(self, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
-            while (self._frames or self._writing) and self.error is None:
+            while (self._frames or self._inflight) and self.error is None:
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -363,63 +833,281 @@ class _PeerWriter:
         with self._cond:
             return self._queued_bytes
 
-    def close(self, timeout: float = 2.0) -> None:
-        """Stop accepting frames, drain what is queued, join the thread."""
+    def depth(self) -> int:
         with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-        if self._thread is not threading.current_thread():
-            # The dying writer itself retires its endpoint through
-            # drop_connection — it cannot join itself.
-            self._thread.join(timeout=timeout)
+            return len(self._frames) + (1 if self._inflight else 0)
 
-    def _main(self) -> None:
-        while True:
-            with self._cond:
-                while not self._frames and not self._closed:
-                    self._cond.wait()
-                if not self._frames:  # closed and drained
-                    return
-                views, nbytes = self._frames.popleft()
-                self._writing = True
+    # -- loop-side state machine --
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        count(f"NET_PEER_STATE[{state}]")
+
+    def on_peer_timer(self) -> None:
+        """Loop tick: advance whatever the current state allows. Kicks
+        from submit, connect-retry and pacing timers, and drain-budget
+        yields all funnel here — a tick is idempotent, so over-kicking
+        is harmless."""
+        with self._cond:
+            self._kicked = False
+        if self.state in (_ST_READY, _ST_DRAINING):
+            self._drain()
+        elif (self.state == _ST_CONNECTING and self._sock is None
+                and time.monotonic() >= self._retry_at):
+            self._dial()
+
+    def on_peer_io(self, mask: int) -> None:
+        if self._sock is None or self.state == _ST_DEAD:
+            return  # stale event: torn down earlier in this batch
+        if self.state == _ST_HANDSHAKE:
+            err = self._sock.getsockopt(socket.SOL_SOCKET,
+                                        socket.SO_ERROR)
+            if err:
+                self._teardown_socket()
+                self._connect_failed(OSError(err, os.strerror(err)))
+            else:
+                self._on_connected()
+            return
+        if mask & selectors.EVENT_READ and not self._probe_eof():
+            return  # died on the read edge
+        if mask & selectors.EVENT_WRITE:
+            self._drain()
+
+    def _dial(self) -> None:
+        """Nonblocking connect attempt: connect_ex + selector-observed
+        completion, with per-peer exponential backoff timers between
+        attempts — the replacement for the old blocking dial loop that
+        parked a writer thread for up to -connect_timeout_s per dead
+        peer."""
+        now = time.monotonic()
+        if not self._deadline:
+            self._deadline = now + float(get_flag("connect_timeout_s"))
+        host, port = self._net._peers[self._dst]
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            err = sock.connect_ex((host, port))
+        except OSError as exc:  # e.g. name resolution failure
             try:
-                # Same lock order as the blocking path (lock, then
-                # lazy-connect, then pace, then write the whole frame).
-                with self._net._out_locks[self._dst]:
-                    sock = self._net._connect(self._dst)
-                    self._net._pace(nbytes)
-                    with monitor("tcp_send"):
-                        _sendmsg_all(sock, views)
-                self._net._count_sent(nbytes)
-            except BaseException as exc:  # noqa: BLE001 - the writer
-                # has no caller to raise into; ANY death (OSError,
-                # MemoryError, ...) must park in self.error and wake
-                # waiters — submit()/flush() then raise PeerLostError
-                # instead of enqueueing into a dead thread.
+                sock.close()
+            except OSError:
+                pass
+            self._connect_failed(exc)
+            return
+        if err != 0 and err not in _EX_PENDING:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._connect_failed(OSError(err, os.strerror(err)))
+            return
+        # Connected-immediately (err 0, loopback) still goes through
+        # HANDSHAKE: the socket is instantly writable, so the selector
+        # confirms it on the next tick — one uniform path.
+        self._sock = sock
+        self._set_state(_ST_HANDSHAKE)
+        self._register(selectors.EVENT_READ | selectors.EVENT_WRITE)
+
+    def _on_connected(self) -> None:
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._deadline = 0.0
+        self._retry_delay = 0.02
+        self._want_write = True  # force the modify down to READ-only
+        self._set_want_write(False)
+        # A peer that finished its handshake after finalize began goes
+        # straight to DRAINING: the queued frames (goodbye included)
+        # still flush, but the state never reads READY.
+        self._set_state(_ST_DRAINING if self.closed else _ST_READY)  # mvlint: ignore[guarded-by] - closed is loop-written after __init__; the cond only orders it for caller-side reads
+        self._drain()
+
+    def _connect_failed(self, exc: BaseException) -> None:
+        now = time.monotonic()
+        if now >= self._deadline:
+            host, port = self._net._peers[self._dst]
+            timeout_s = float(get_flag("connect_timeout_s"))
+            # Typed as a lost peer: unreachable-within-timeout is
+            # exactly the retryable condition (bootstrap race or a
+            # crashed rank whose replacement has not bound yet). No
+            # peer-lost report — parity with the old blocking dialer,
+            # whose deadline raised into the sender without declaring
+            # the peer dead.
+            self._die(PeerLostError(
+                f"rank {self._net.rank}: cannot reach rank {self._dst} "
+                f"at {host}:{port} within {timeout_s}s"), report=False)
+            return
+        if self.state != _ST_CONNECTING:
+            self._set_state(_ST_CONNECTING)
+        self._retry_at = now + self._retry_delay
+        self._retry_delay = min(self._retry_delay * 2, 0.5)
+        self._loop.call_later(self._retry_at - now, self)
+
+    def _probe_eof(self) -> bool:
+        """READ readiness on the outbound socket. The protocol never
+        sends bytes back on this direction, so readability means EOF or
+        an error — the selector-observed half of dead-peer detection.
+        EOF with frames queued is a mid-send death (report it); EOF on
+        an idle peer is the remote side's own graceful close racing
+        ours — retire quietly and let the next send dial fresh."""
+        try:
+            k = self._sock.recv_into(self._eof_scratch)  # mvlint: ignore[thread-role] - nonblocking fd: EAGAIN raises, never parks
+        except BlockingIOError:
+            return True
+        except OSError as exc:
+            self._die(exc)
+            return False
+        if k:
+            return True  # stray bytes: not ours to interpret
+        with self._cond:
+            busy = bool(self._frames) or self._inflight
+        self._die(ConnectionResetError(
+            errno.ECONNRESET,
+            f"rank {self._dst} closed the connection"), report=busy)
+        return False
+
+    def _drain(self) -> None:
+        """Write queued frames with nonblocking vectored sends — the
+        same partial-send arithmetic as ``_sendmsg_all``, suspended on
+        EAGAIN (WRITE interest re-arms it) instead of blocking."""
+        sock = self._sock
+        if sock is None or self.state not in (_ST_READY, _ST_DRAINING):
+            return
+        budget = self._DRAIN_FRAMES
+        while True:
+            cur = self._cur
+            if cur is None:
                 with self._cond:
-                    self.error = exc
-                    self._frames.clear()
-                    self._queued_bytes = 0
-                    self._writing = False
-                    self._cond.notify_all()
-                # Mark the ENDPOINT dead too (outside our lock): drop
-                # the broken cached socket so a later retry reconnects,
-                # and report the peer so the zoo can fail blocked
-                # waiters instead of letting them hang. Quiet during
-                # finalize — a teardown race is not a peer death.
-                if isinstance(exc, OSError) and not self._net._closed:
-                    self._net._peer_connection_died(self._dst, exc)
+                    if not self._frames:
+                        break
+                    views, nbytes, t_submit, goodbye = \
+                        self._frames.popleft()
+                    self._inflight = True
+                cur = self._cur = [views, 0, 0, nbytes, t_submit, goodbye]
+                self._pace_until = self._net._pace_reserve(nbytes)
+            if self._pace_until:
+                now = time.monotonic()
+                if now + self._PACE_SLACK < self._pace_until:
+                    # Paced frame not due yet: park on a loop timer,
+                    # not a sleep — every other fd keeps being served.
+                    self._set_want_write(False)
+                    self._loop.call_later(self._pace_until - now, self)
+                    return
+                self._pace_until = 0.0
+            views, i, off, nbytes, t_submit, goodbye = cur
+            n = len(views)
+            try:
+                while i < n:
+                    if off:
+                        batch = [views[i][off:]]
+                        batch.extend(views[i + 1:i + _IOV_CAP])
+                    else:
+                        batch = views[i:i + _IOV_CAP]
+                    with monitor("tcp_send"):
+                        sent = sock.sendmsg(batch)
+                    while i < n and sent:
+                        remaining = views[i].nbytes - off
+                        if sent >= remaining:
+                            sent -= remaining
+                            i += 1
+                            off = 0
+                        else:
+                            off += sent
+                            sent = 0
+            except BlockingIOError:
+                cur[1], cur[2] = i, off  # resume exactly here
+                self._set_want_write(True)
                 return
-            # Drop the view list BEFORE parking in the next wait: the
-            # views alias payload buffers (possibly a pooled receive
-            # frame being forwarded), and an idle writer holding its
-            # last frame's views would pin that memory until the next
-            # send to this peer.
-            views = None
+            except OSError as exc:
+                self._die(exc)
+                return
+            # Frame complete (kernel accepted every byte). Drop the
+            # view list before anything else: the views alias payload
+            # buffers (possibly a pooled receive frame being
+            # forwarded), and holding them would pin that memory.
+            self._cur = None
+            views = cur = None
+            self._net._count_sent(nbytes)
+            self._lat_gauge.add((time.perf_counter() - t_submit) * 1e3)
             with self._cond:
                 self._queued_bytes -= nbytes
-                self._writing = False
+                self._inflight = False
                 self._cond.notify_all()
+            if goodbye:
+                self._finish_close()
+                return
+            budget -= 1
+            if budget <= 0:
+                # Yield the tick: WRITE interest re-fires immediately
+                # while the socket stays writable, so the remaining
+                # frames interleave with other fds' service.
+                self._set_want_write(True)
+                return
+        self._set_want_write(False)
+
+    def _finish_close(self) -> None:
+        """Goodbye frame fully written: the graceful half of DRAINING →
+        DEAD. No error parks — flush() returns normally."""
+        self._teardown_socket()
+        self._set_state(_ST_DEAD)
+        self._net._retire_peer(self)
+        with self._cond:
+            self._cond.notify_all()
+
+    def _die(self, exc: BaseException, report: bool = True) -> None:
+        """Peer death on the loop: close the socket, park the error for
+        submit/flush waiters, clear the queue (the old writer threads
+        did the same — zoo.peer_lost fails the stranded requests), and
+        retire this machine from the peer table."""
+        if self.state == _ST_DEAD:
+            return
+        self._teardown_socket()
+        self._cur = None
+        self._pace_until = 0.0
+        self._set_state(_ST_DEAD)
+        with self._cond:
+            if self.error is None:
+                self.error = exc
+            self._frames.clear()
+            self._queued_bytes = 0
+            self._inflight = False
+            self._cond.notify_all()
+        self._net._retire_peer(self)
+        if report and not self.closed:  # mvlint: ignore[guarded-by] - loop-side read; closed only transitions False->True, worst case a report during finalize that abort ignores
+            self._net._report_send_death(self._dst, exc)
+
+    def kill(self, exc: BaseException) -> None:
+        """Teardown entry for drop_connection/finalize: death without a
+        peer-lost report."""
+        self._die(exc, report=False)
+
+    def _teardown_socket(self) -> None:
+        self._unregister()
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _register(self, mask: int) -> None:
+        self._loop.register(self._sock, mask, self)
+        self._registered = True
+        self._want_write = bool(mask & selectors.EVENT_WRITE)
+
+    def _unregister(self) -> None:
+        if self._registered and self._sock is not None:
+            try:
+                self._loop.unregister(self._sock)
+            except (KeyError, ValueError):
+                pass
+        self._registered = False
+
+    def _set_want_write(self, want: bool) -> None:
+        if want == self._want_write or not self._registered:
+            return
+        self._want_write = want
+        mask = selectors.EVENT_READ
+        if want:
+            mask |= selectors.EVENT_WRITE
+        self._loop.modify(self._sock, mask, self)
 
 
 class TcpNet(NetInterface):
@@ -428,6 +1116,10 @@ class TcpNet(NetInterface):
     #: Optional callback fired when a peer connection dies while the
     #: mesh is still supposed to be up (set by Zoo.start -> Zoo.abort).
     on_peer_lost = None
+
+    #: Live instances (the test-suite leak guard scopes its FD baseline
+    #: check to tests that actually built an endpoint).
+    instances_created = 0
 
     def __init__(self, rank: int, endpoints: List[str],
                  default_port: Optional[int] = None):
@@ -439,30 +1131,48 @@ class TcpNet(NetInterface):
         self._rank = rank
         self._peers = [_parse_endpoint(e, port) for e in endpoints]
         self._inbox: MtQueue = MtQueue()
-        self._out_locks = [named_lock(f"tcp[r{rank}].out[{d}]")
-                           for d in range(len(endpoints))]
         self._lifecycle = named_lock(f"tcp[r{rank}].lifecycle")
-        self._out: Dict[int, socket.socket] = {}  # guarded_by: _lifecycle
-        self._writers: Dict[int, _PeerWriter] = {}  # guarded_by: _lifecycle
+        self._out_peers: Dict[int, _Peer] = {}  # guarded_by: _lifecycle
         self._closed = False  # guarded_by: _lifecycle
-        self._readers: List[threading.Thread] = []
         self._stats_lock = named_lock(f"tcp[r{rank}].stats")
         self._bytes_sent = 0  # guarded_by: _stats_lock
         self._wire_free_at = 0.0  # guarded_by: _stats_lock
-        # Receive-frame pool, shared by every reader thread of this
+        # Receive-frame pool shared by every inbound connection of this
         # endpoint (the leases are what recycle the buffers; the pool
-        # itself only caps what is RETAINED, so readers never block).
+        # itself only caps what is RETAINED, so reads never block).
         self._pool = BufferPool()
+        self._conns: set = set()  # loop-thread only: live inbound conns
+        self._transport_gauge = samples("TRANSPORT_THREADS")
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("", self._peers[rank][1]))
         self._listener.listen(len(endpoints) + 4)
-        self._accept_thread = thread_roles.spawn(
-            thread_roles.BACKGROUND, target=self._accept_main,
-            name=f"mv-tcp-accept-r{rank}")
+        self._listener.setblocking(False)
+        self._loop = _EventLoop(rank)
+        self._loop.call_soon(self._start_on_loop)
+        TcpNet.instances_created += 1
         log.debug("TcpNet rank %d listening on %s:%d", rank,
                   self._peers[rank][0], self._peers[rank][1])
+
+    def _start_on_loop(self) -> None:
+        self._loop.register(self._listener, selectors.EVENT_READ,
+                            _Listener(self))
+        self.on_misc_timer()
+
+    def on_misc_timer(self) -> None:
+        """Housekeeping tick (~2s on the loop): record the transport
+        thread gauge — O(1) in peer count is the point of the
+        event-loop core, and TRANSPORT_THREADS is how the bench's
+        many-connection arm proves it."""
+        alive = thread_roles.roles_alive()
+        self._transport_gauge.add(
+            alive.get(thread_roles.EVENTLOOP, 0)
+            + alive.get(thread_roles.WRITER, 0))
+        # Racy re-arm guard by design: one extra tick after finalize at
+        # worst — the loop exits right after.
+        if not self._closed:  # mvlint: ignore[guarded-by]
+            self._loop.call_later(2.0, self)
 
     # -- NetInterface --
     @property
@@ -476,46 +1186,30 @@ class TcpNet(NetInterface):
     def send(self, msg: Message) -> int:
         """Serialize + send, each under a Dashboard monitor (the
         reference instruments exactly these wire phases,
-        ref: mpi_net.h:292-342 MVA_NET_SERIALIZE/SEND sites)."""
+        ref: mpi_net.h:292-342 MVA_NET_SERIALIZE/SEND sites). The
+        blocking path is submit + flush on the destination's queue:
+        FIFO with earlier async frames for free, and the caller parks
+        in a timed wait, never on a socket."""
         dst = msg.dst
         if not 0 <= dst < self.size:
             raise ValueError(f"bad dst rank {dst}")
-        # Lock-free probe: a miss only skips the pre-send flush for a
-        # writer created concurrently — which then has no queued frames
-        # to reorder with this sync frame.
-        writer = self._writers.get(dst)  # mvlint: ignore[guarded-by]
-        if writer is not None:
-            # FIFO with earlier async frames: a sync frame overtaking
-            # queued async ones would reorder the peer's stream.
-            writer.flush(timeout=60.0)
         tid = trace_of(msg)
         with monitor("tcp_serialize"), \
                 tracing.span(tid, "tcp_serialize", self._rank):
             views, nbytes = _frame_views(msg)
-        try:
-            with monitor("tcp_send"), \
-                    tracing.span(tid, "tcp_send", self._rank,
-                                 args={"dst": dst,
-                                       "bytes": nbytes}
-                                 if tid else None):
-                with self._out_locks[dst]:
-                    sock = self._connect(dst)
-                    self._pace(nbytes)
-                    _sendmsg_all(sock, views)
-        except OSError as exc:
-            # Broken connection mid-send: drop the cached socket (a
-            # retry must reconnect, not re-use the corpse), report the
-            # peer, and surface a typed retryable error.
-            self._peer_connection_died(dst, exc)
-            raise PeerLostError(
-                f"send to rank {dst} failed: {exc}") from exc
-        self._count_sent(nbytes)
+        with tracing.span(tid, "tcp_send", self._rank,
+                          args={"dst": dst, "bytes": nbytes}
+                          if tid else None):
+            peer = self._peer(dst)
+            peer.submit(views, nbytes)
+            peer.flush(timeout=60.0)
         return nbytes
 
     def send_async(self, msg: Message) -> int:
-        """Queue one serialized frame on the destination's writer thread
-        and return immediately (the non-blocking half of the chunked
-        allreduce pipeline: multiple frames in flight per peer)."""
+        """Queue one serialized frame on the destination's peer state
+        machine and return immediately (the non-blocking half of the
+        chunked allreduce pipeline: multiple frames in flight per
+        peer)."""
         dst = msg.dst
         if not 0 <= dst < self.size:
             raise ValueError(f"bad dst rank {dst}")
@@ -538,75 +1232,84 @@ class TcpNet(NetInterface):
                 tracing.span(tid, "tcp_serialize", self._rank):
             views, nbytes = _frame_views(msg)
         if tid:
-            # The actual socket write happens on the writer thread,
-            # which only sees bytes — the submit marker is the async
-            # path's wire hop for sampled traces.
+            # The actual socket write happens on the event loop, which
+            # only sees bytes — the submit marker is the async path's
+            # wire hop for sampled traces.
             tracing.event(tid, "tcp_send_async_submit", self._rank,
                           args={"dst": dst, "bytes": nbytes})
-        self._writer(dst).submit(views, nbytes)
+        self._peer(dst).submit(views, nbytes)
         return nbytes
 
     def flush_sends(self, dst: Optional[int] = None,
                     timeout: Optional[float] = None) -> None:
         # Snapshot under the lock (a concurrent drop_connection must
         # not mutate the dict mid-iteration); flush OUTSIDE it — flush
-        # blocks, and _writer() needs the lock to register new peers.
+        # blocks, and _peer() needs the lock to register new peers.
         with self._lifecycle:
-            writers = [self._writers[dst]] if dst is not None \
-                and dst in self._writers else \
-                (list(self._writers.values()) if dst is None else [])
-        for writer in writers:
-            writer.flush(timeout)
+            peers = [self._out_peers[dst]] if dst is not None \
+                and dst in self._out_peers else \
+                (list(self._out_peers.values()) if dst is None else [])
+        for peer in peers:
+            peer.flush(timeout)
+
+    def queue_depths(self) -> Dict[int, int]:
+        """Outbound frames queued (or mid-write) per destination — the
+        live-introspection port autotune and the bench read."""
+        with self._lifecycle:
+            peers = list(self._out_peers.items())
+        return {dst: peer.depth() for dst, peer in peers}
 
     @property
     def bytes_sent(self) -> int:
         with self._stats_lock:
             return self._bytes_sent
 
-    def _writer(self, dst: int) -> _PeerWriter:
+    def _peer(self, dst: int) -> _Peer:
         # Double-checked probe: the hot async-send path skips the
         # lifecycle lock; the slow path below re-reads under it.
-        writer = self._writers.get(dst)  # mvlint: ignore[guarded-by]
-        if writer is None:
+        peer = self._out_peers.get(dst)  # mvlint: ignore[guarded-by]
+        if peer is None:
             with self._lifecycle:
                 if self._closed:
                     raise RuntimeError("TcpNet finalized")
-                writer = self._writers.get(dst)
-                if writer is None:
-                    writer = self._writers[dst] = _PeerWriter(self, dst)
-        return writer
+                peer = self._out_peers.get(dst)
+                if peer is None:
+                    peer = self._out_peers[dst] = _Peer(self, dst)
+        return peer
 
     # -- peer-death bookkeeping --
     def drop_connection(self, dst: int) -> None:
-        """Forget the outbound connection state for ``dst``: close the
-        cached socket and retire a (possibly dead) writer thread. The
-        next send toward ``dst`` reconnects from scratch — the
-        fault-tolerance retry path calls this when a peer is declared
-        dead so a restarted replacement process is actually reachable
-        instead of every retry hitting the broken socket."""
+        """Forget the outbound connection state for ``dst``: retire the
+        (possibly dead) peer state machine. The next send toward
+        ``dst`` reconnects from scratch — the fault-tolerance retry
+        path calls this when a peer is declared dead so a restarted
+        replacement process is actually reachable instead of every
+        retry hitting the broken socket."""
         with self._lifecycle:
-            sock = self._out.pop(dst, None)
-            writer = self._writers.pop(dst, None)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
-        if writer is not None:
-            writer.close(timeout=0.5)
+            peer = self._out_peers.pop(dst, None)
+        if peer is None:
+            return
+        exc = PeerLostError(f"connection to rank {dst} dropped")
+        if self._loop.on_loop():
+            peer.kill(exc)
+        else:
+            self._loop.call_soon(lambda: peer.kill(exc))
 
-    def _peer_connection_died(self, dst: int, exc: BaseException) -> None:
-        """A connection toward ``dst`` broke while the mesh is live:
-        drop it and report the peer (readers report via their own dirty
-        -close path; this covers the SEND side, where the rank is
-        known)."""
+    def _retire_peer(self, peer: _Peer) -> None:
+        with self._lifecycle:
+            if self._out_peers.get(peer._dst) is peer:
+                del self._out_peers[peer._dst]
+
+    def _report_send_death(self, dst: int, exc: BaseException) -> None:
+        """A connection toward ``dst`` broke while the mesh is live
+        (inbound conns report via their own dirty-close path; this
+        covers the SEND side, where the rank is known)."""
         # Racy loop-guard read by design: a teardown racing a peer
         # death at worst reports a peer that finalize already forgot.
         if self._closed:  # mvlint: ignore[guarded-by]
             return
         log.error("TcpNet rank %d: connection to rank %d died: %s",
                   self._rank, dst, exc)
-        self.drop_connection(dst)
         hook = self.on_peer_lost
         if hook is not None:
             try:
@@ -615,28 +1318,49 @@ class TcpNet(NetInterface):
                 # not take the transport down with it
                 pass
 
+    def _conn_died(self, peer: Optional[int]) -> None:
+        """Dirty close of an inbound connection: the send side toward
+        that peer is stale too — drop it so retries reconnect rather
+        than write into the corpse, then report the loss."""
+        if peer is not None:
+            self.drop_connection(peer)
+        hook = self.on_peer_lost
+        if hook is not None:
+            try:
+                hook(peer)
+            except Exception:  # noqa: BLE001 - abort must not die
+                pass
+
     def _count_sent(self, nbytes: int) -> None:
         with self._stats_lock:
             self._bytes_sent += nbytes
 
-    def _pace(self, nbytes: int) -> None:
-        """Emulated-wire pacing: one shared outbound link per endpoint,
-        modeled as an absolute busy-until deadline. Each frame reserves
-        its transmission slot and sleeps toward the deadline, so an
-        OVERSLEEP on one frame (common when compute threads load the
-        core) credits the next frame instead of accumulating — without
-        this, many-small-frame paths pay per-sleep scheduler jitter
-        that a few-big-frame path does not, skewing comparisons."""
+    def _pace_reserve(self, nbytes: float) -> float:
+        """Emulated-wire pacing (-net_pace_mbps): one shared outbound
+        link per endpoint, modeled as an absolute busy-until deadline.
+        Each frame reserves its transmission slot and returns the
+        monotonic time before which it must not be written (0.0 when
+        pacing is off); the event loop holds the frame on a timer until
+        then. An overrun on one frame credits the next instead of
+        accumulating — same arithmetic the sleeping version used, just
+        parked on a timer instead of a thread."""
         mbps = float(get_flag("net_pace_mbps"))
         if mbps <= 0:
-            return
+            return 0.0
         tx = nbytes * 8.0 / (mbps * 1e6)
         with self._stats_lock:
-            start = max(time.monotonic(), self._wire_free_at)
+            # Bounded catch-up credit: the loop wakes for a paced frame
+            # with ms-scale jitter (epoll granularity + GIL handoff),
+            # and anchoring each slot at max(now, busy-until) would
+            # compound every late wake into all later slots — the
+            # emulated wire would run measurably under its configured
+            # rate. Let the bucket keep its own timeline instead,
+            # unless the sender falls more than the credit window
+            # behind (idle links still never bank unbounded burst).
+            start = max(time.monotonic() - _PACE_CREDIT_S,
+                        self._wire_free_at)
             self._wire_free_at = target = start + tx
-        delay = target - time.monotonic()
-        if delay > 0:
-            time.sleep(delay)
+        return target
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Message]:
         item = self._inbox.pop(timeout=timeout)
@@ -646,220 +1370,96 @@ class TcpNet(NetInterface):
 
     def deliver(self, msg: Message) -> None:
         """Inject a locally received message into the inbox — the
-        delivery port of the shm ring poller (runtime/shm.py), so
+        delivery port of the shm ring service (runtime/shm.py), so
         ring-borne and socket-borne frames share one queue and recv
         keeps its blocking semantics and per-source FIFO."""
         self._inbox.push(msg)
+
+    # -- inbound-conn bookkeeping (loop thread) --
+    def _register_conn(self, conn: _Conn) -> None:
+        self._conns.add(conn)
+        self._loop.register(conn._sock, selectors.EVENT_READ, conn)
+
+    def _unregister_conn(self, conn: _Conn) -> None:
+        self._conns.discard(conn)
+        try:
+            self._loop.unregister(conn._sock)
+        except (KeyError, ValueError):
+            pass
 
     def finalize(self) -> None:
         with self._lifecycle:
             if self._closed:
                 return
             self._closed = True
-            # Steal the writer table while holding the lock: the drain
-            # below iterates it OUTSIDE the lock (flush blocks), and a
-            # concurrent drop_connection popping the live dict
-            # mid-iteration would raise RuntimeError. self._out must
-            # stay populated until the writers are drained — their
-            # sends go through _connect, which needs the cached
-            # sockets (and refuses to dial anew once _closed is set).
-            writers, self._writers = dict(self._writers), {}
+            peers = dict(self._out_peers)
+        # Stop accepting, then queue a goodbye frame (length 0 — tells
+        # each peer's receive side this close is GRACEFUL) behind every
+        # destination's remaining traffic. DRAINING peers flush queued
+        # frames first, so a goodbye can never truncate the stream
+        # mid-payload — a ring allreduce returns once it has RECEIVED
+        # everything, so its final-step sends may still be queued here,
+        # and a peer's collective depends on them.
+        self._loop.run_sync(self._teardown_listener, timeout=2.0)
+        self._loop.run_sync(
+            lambda: [self._begin_drain(p) for p in peers.values()],
+            timeout=5.0)
+        # Bounded drain per peer, scaled by what is queued (wire-rate
+        # paced frames can legitimately take many seconds); a wedged or
+        # dead peer is force-killed below.
+        pace = float(get_flag("net_pace_mbps"))
+        for peer in peers.values():
+            pending = peer.queued_bytes
+            drain = 2.0 + pending / (4 << 20)  # >=4 MB/s of real wire
+            if pace > 0:
+                drain += pending * 8.0 / (pace * 1e6)
+            try:
+                peer.flush(timeout=drain)
+            except (PeerLostError, RuntimeError):
+                pass
+        self._loop.run_sync(self._teardown_links, timeout=5.0)
+        self._loop.stop(timeout=5.0)
+        self._inbox.exit()
+
+    def _teardown_listener(self) -> None:
+        try:
+            self._loop.unregister(self._listener)
+        except (KeyError, ValueError):
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
-        # Drain + stop the async writers BEFORE the goodbye frames: a
-        # goodbye racing past queued frames would truncate the peer's
-        # stream mid-payload — a ring allreduce returns once it has
-        # RECEIVED everything, so its final-step sends may still be
-        # queued when the caller shuts down, and a peer's collective
-        # depends on them. The drain bound scales with what is queued
-        # (wire-rate paced frames can legitimately take many seconds);
-        # a truly wedged writer is abandoned after that (daemon thread;
-        # the socket close below unblocks any sendall it is stuck in).
-        pace = float(get_flag("net_pace_mbps"))
-        for writer in writers.values():
-            pending = writer.queued_bytes
-            drain = 2.0 + pending / (4 << 20)  # ≥4 MB/s of real wire
-            if pace > 0:
-                drain += pending * 8.0 / (pace * 1e6)
-            try:
-                writer.flush(timeout=drain)
-            except RuntimeError:
-                pass
-            writer.close(timeout=2.0)
-        # Only now steal the socket table: every writer has drained (or
-        # been abandoned), so nothing sends through _out anymore.
+
+    def _begin_drain(self, peer: _Peer) -> None:
+        """Finalize, on the loop: refuse new frames, queue the goodbye.
+        READY → DRAINING; a peer still connecting keeps its backoff
+        machine (the goodbye flushes if the handshake completes within
+        the drain bound, else the force-kill reaps it)."""
+        with peer._cond:
+            already = peer.closed
+            peer.closed = True
+            peer._cond.notify_all()
+        if already or peer.state == _ST_DEAD:
+            return
+        if peer.state == _ST_READY:
+            peer._set_state(_ST_DRAINING)
+        try:
+            peer.submit([memoryview(_LEN.pack(0))], _LEN.size,
+                        goodbye=True)
+        except (PeerLostError, RuntimeError):
+            pass  # already dead: nothing to say goodbye on
+
+    def _teardown_links(self) -> None:
         with self._lifecycle:
-            out, self._out = dict(self._out), {}
-        for dst, sock in out.items():
-            # Goodbye frame (length 0): tells the peer's reader this
-            # close is GRACEFUL, so peer-death detection stays quiet.
-            # Take the per-destination send lock (with a bound — a
-            # wedged sender must not hang shutdown) so the goodbye
-            # cannot interleave into a frame a sender is mid-writing,
-            # and bound the send itself: a peer that is alive but not
-            # reading (full receive buffer) would otherwise block
-            # sendall indefinitely.
-            with acquire_timeout(self._out_locks[dst], 2.0) as locked:
-                if locked:
-                    # Without the lock, a goodbye could interleave into a
-                    # frame a sender is mid-writing and corrupt the
-                    # peer's stream; skipping it merely degrades to the
-                    # dirty-close signal the goodbye would have avoided.
-                    try:
-                        sock.settimeout(2.0)
-                        sock.sendall(_LEN.pack(0))
-                    except OSError:
-                        pass
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-        self._inbox.exit()
+            stragglers = list(self._out_peers.values())
+        for peer in stragglers:
+            peer.kill(RuntimeError("TcpNet finalized"))
+        for conn in list(self._conns):
+            conn.close_for_teardown()
 
     def interrupt_recv(self) -> None:
         self._inbox.push(_RECV_INTERRUPT)
-
-    # -- outbound mesh --
-    def _connect(self, dst: int) -> socket.socket:
-        """Connection to dst, established lazily with retry (a peer may not
-        have bound yet during bootstrap — the reference's ZMQ connect is
-        similarly fire-and-wait, ref: zmq_net.h:50-59)."""
-        # Lock-free fast path: callers already serialize per
-        # destination via _out_locks[dst], so the probe cannot race
-        # another connect to the SAME dst; the insert re-checks under
-        # _lifecycle.
-        sock = self._out.get(dst)  # mvlint: ignore[guarded-by]
-        if sock is not None:
-            return sock
-        host, port = self._peers[dst]
-        connect_timeout = float(get_flag("connect_timeout_s"))
-        deadline = time.monotonic() + connect_timeout
-        delay = 0.02
-        while True:
-            # Racy abort check by design: the insert below re-checks
-            # _closed under _lifecycle before publishing the socket.
-            if self._closed:  # mvlint: ignore[guarded-by]
-                raise RuntimeError("TcpNet finalized")
-            try:
-                sock = socket.create_connection((host, port), timeout=10)
-                break
-            except OSError as exc:
-                if time.monotonic() >= deadline:
-                    # Typed as a lost peer: unreachable-within-timeout is
-                    # exactly the retryable condition (bootstrap race or
-                    # a crashed rank whose replacement has not bound yet).
-                    raise PeerLostError(
-                        f"rank {self._rank}: cannot reach rank {dst} "
-                        f"at {host}:{port} within {connect_timeout}s"
-                    ) from exc
-                time.sleep(delay)
-                delay = min(delay * 2, 0.5)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        sock.settimeout(None)
-        with self._lifecycle:
-            if self._closed:
-                # finalize() ran while we were connecting; don't leak the
-                # socket or let a send slip out after teardown.
-                sock.close()
-                raise RuntimeError("TcpNet finalized")
-            self._out[dst] = sock
-        return sock
-
-    # -- inbound mesh --
-    def _accept_main(self) -> None:
-        # Racy loop guard by design: finalize closing the listener is
-        # what actually stops this thread (accept raises OSError).
-        while not self._closed:  # mvlint: ignore[guarded-by]
-            try:
-                conn, _addr = self._listener.accept()
-            except OSError:
-                return  # listener closed
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            reader = thread_roles.spawn(
-                thread_roles.BACKGROUND, target=self._reader_main,
-                args=(conn,), name=f"mv-tcp-read-r{self._rank}")
-            self._readers.append(reader)
-
-    def _read_frame(self, conn: socket.socket,
-                    total: int) -> Optional[Message]:
-        """Read + parse one frame body. Zero-copy path: lease a pooled
-        buffer, ``recv_into`` it, and cut read-only Blob views straight
-        from the frame (the lease rides the Blobs and recycles the
-        buffer when the last one dies). ``-zero_copy=0`` restores the
-        legacy read-then-copy parse. None on EOF mid-frame."""
-        if bool(get_flag("zero_copy")):
-            lease = self._pool.lease(total)
-            with monitor("tcp_recv"):
-                if not _recv_into_exact(conn, lease.view(total)):
-                    lease.release()
-                    return None
-            with monitor("tcp_deserialize"):
-                return _deserialize_frame(lease.view(total), lease)
-        with monitor("tcp_recv"):
-            body = _read_exact(conn, total)
-        if body is None:
-            return None
-        with monitor("tcp_deserialize"):
-            return _deserialize(body)
-
-    def _reader_main(self, conn: socket.socket) -> None:
-        clean = False
-        peer = None  # rank learned from the frames this conn carries
-        try:
-            # Racy loop guard by design: the conn close in finalize is
-            # what actually unblocks a parked reader.
-            while not self._closed:  # mvlint: ignore[guarded-by]
-                head = _read_exact(conn, _LEN.size)
-                if head is None:
-                    return
-                (total,) = _LEN.unpack(head)
-                if total == 0:  # goodbye frame: graceful peer close
-                    clean = True
-                    return
-                t0_ns = tracing.now_ns()
-                msg = self._read_frame(conn, total)
-                if msg is None:
-                    return
-                tid = trace_of(msg)
-                if tid:
-                    # The trace id is only known after the parse; the
-                    # span still covers the read+deserialize window.
-                    tracing.add_span(tid, "tcp_recv", self._rank,
-                                     t0_ns, tracing.now_ns() - t0_ns,
-                                     args={"bytes": total})
-                # Every inbound frame names its sender; remembering it
-                # lets a dirty close report WHICH peer died (the zoo's
-                # rejoin path fails only that rank's in-flight requests
-                # instead of aborting the whole cluster).
-                if 0 <= msg.src < self.size and msg.src != self._rank:
-                    peer = msg.src
-                self._inbox.push(msg)
-            clean = True
-        except OSError:
-            return  # torn down mid-read
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            # Racy teardown check by design: worst case is one spurious
-            # peer-lost report during finalize, which abort ignores.
-            if not clean and not self._closed:  # mvlint: ignore[guarded-by]
-                # A peer hung up while the mesh is live: report it so the
-                # zoo can abort blocked waits (the reference has no such
-                # detection — a dead MPI rank hangs the cluster). The
-                # send side toward that peer is stale too — drop it so
-                # retries reconnect rather than write into the corpse.
-                if peer is not None:
-                    self.drop_connection(peer)
-                hook = self.on_peer_lost
-                if hook is not None:
-                    try:
-                        hook(peer)
-                    except Exception:  # noqa: BLE001 - abort must not die
-                        pass
 
     # -- bootstrap --
     @classmethod

@@ -3,19 +3,25 @@ watchdog — the runtime twin of mvlint pass 9 (``thread-role``).
 
 Every thread the package starts carries a declared **role**:
 
-* ``DISPATCH`` — the communicator's message loops. A blocked dispatch
+* ``EVENTLOOP`` — the transport's selector loop (one per endpoint):
+  every socket accept/connect/read/write, retry and pacing timers,
+  and the shm doorbell multiplex onto it. The ONLY call that may park
+  it is ``selector.select(timeout)`` in its entry frame — pass 9
+  proves nothing else blocking is reachable from a handler.
+* ``DISPATCH`` — the communicator's receive loop. A blocked dispatch
   thread starves every control/liveness frame behind it (the PR-6/
   PR-9/PR-12 failure class, ROADMAP item 3).
 * ``LIVENESS`` — the heartbeat monitor. Blocking here turns a healthy
   cluster into a false-positive death sentence.
 * ``ACTOR`` — worker/server/controller run loops. May block on their
   own mailbox and on bounded table work.
-* ``WRITER`` — per-destination outbound writers (TCP peer writers,
-  dispatch-queue drainers). Blocking on the wire is their *job*: they
-  exist so nothing latency-critical has to.
-* ``BACKGROUND`` — everything else (readers, accept loops, metrics,
-  snapshots, autotune, serving, prefetchers). Bounded-blocking by
-  design, no budget enforced.
+* ``WRITER`` — the shm ring writers, the one queue-drainer class left:
+  a full ring blocks the producer by design (bounded backpressure),
+  which the event loop must never do. Blocking on the transport is
+  their *job*: they exist so nothing latency-critical has to.
+* ``BACKGROUND`` — everything else (metrics, snapshots, autotune,
+  serving, prefetchers). Bounded-blocking by design, no budget
+  enforced.
 
 Threads register their role at spawn through :func:`spawn` (mvlint
 pass 9 bans raw ``threading.Thread`` in the package), and the literal
@@ -24,9 +30,9 @@ cross-checks it BOTH directions against the spawn sites it discovers
 through the call graph, and against the ``docs/THREADS.md`` table
 (the WIRE_FORMAT.md registry precedent). Keys are
 ``<path-under-multiverso_tpu>::<qualname>`` of the *bound* entry
-point: ``Actor._main`` spawned by a ``Communicator`` registers as
-``runtime/communicator.py::Communicator._main`` — the role follows
-the receiver's class, not where the ``def`` lexically lives.
+point: ``Actor._main`` spawned by a ``Server`` registers as
+``runtime/server.py::Server._main`` — the role follows the
+receiver's class, not where the ``def`` lexically lives.
 
 Under ``-debug_locks`` a watchdog samples ``sys._current_frames()``
 and reports any DISPATCH/LIVENESS thread whose innermost frame has
@@ -59,11 +65,12 @@ ACTOR = "ACTOR"
 LIVENESS = "LIVENESS"
 WRITER = "WRITER"
 BACKGROUND = "BACKGROUND"
+EVENTLOOP = "EVENTLOOP"
 
-ROLES = (DISPATCH, ACTOR, LIVENESS, WRITER, BACKGROUND)
+ROLES = (DISPATCH, ACTOR, LIVENESS, WRITER, BACKGROUND, EVENTLOOP)
 
 #: Roles the watchdog budgets (and pass 9 proves non-blocking).
-CRITICAL_ROLES = (DISPATCH, LIVENESS)
+CRITICAL_ROLES = (DISPATCH, LIVENESS, EVENTLOOP)
 
 #: Canonical thread inventory: entry point -> role. mvlint pass 9
 #: derives the same table from the spawn sites + call graph and
@@ -76,15 +83,10 @@ THREAD_ROLES = {
     "runtime/server.py::Server._main": ACTOR,
     "runtime/server.py::SyncServer._main": ACTOR,
     "runtime/controller.py::Controller._main": ACTOR,
-    "runtime/communicator.py::Communicator._main": DISPATCH,
     "runtime/communicator.py::Communicator._recv_main": DISPATCH,
-    "runtime/communicator.py::_DispatchQueues._main": WRITER,
     "runtime/controller.py::HeartbeatMonitor._main": LIVENESS,
-    "runtime/tcp.py::_PeerWriter._main": WRITER,
-    "runtime/tcp.py::TcpNet._accept_main": BACKGROUND,
-    "runtime/tcp.py::TcpNet._reader_main": BACKGROUND,
+    "runtime/tcp.py::_EventLoop._main": EVENTLOOP,
     "runtime/shm.py::_ShmPeerWriter._main": WRITER,
-    "runtime/shm.py::ShmNet._poll_main": BACKGROUND,
     "runtime/metrics.py::MetricsReporter._main": BACKGROUND,
     "runtime/snapshot.py::SnapshotManager._main": BACKGROUND,
     "runtime/autotune.py::AutotuneManager._main": BACKGROUND,

@@ -57,7 +57,8 @@ define_int("coalesce_max_msgs", 64,
            "messages even while the mailbox is still busy — an "
            "unbounded batch would trade latency for no extra win. "
            "Live-retunable (docs/AUTOTUNE.md): the autotune "
-           "controller backs this off when dispatch queues sit deep")
+           "controller backs this off when outbound send queues sit "
+           "deep")
 define_int("coalesce_max_kb", 4096,
            "flush a server's staged coalesced-Add batch at this many "
            "KILOBYTES of payload (the byte twin of "

@@ -144,18 +144,56 @@ class Zoo:
                 lambda rank=None: self.peer_lost(rank, "connection died")
         self._role_override = role
         if not get_flag("ma"):
-            self._start_ps()
-            self._last_controller_reply = time.monotonic()
-            interval = float(get_flag("heartbeat_interval_s", 0.0))
-            if interval > 0:
-                from .controller import HeartbeatMonitor
-                self._heartbeat = HeartbeatMonitor(self)
-                self._heartbeat.start()
-            self._start_observability()
-            self._start_serving()
+            try:
+                self._start_ps()
+                self._last_controller_reply = time.monotonic()
+                interval = float(get_flag("heartbeat_interval_s", 0.0))
+                if interval > 0:
+                    from .controller import HeartbeatMonitor
+                    self._heartbeat = HeartbeatMonitor(self)
+                    self._heartbeat.start()
+                self._start_observability()
+                self._start_serving()
+            except BaseException:
+                # A sibling rank's abort can land while this rank is
+                # still inside the start barrier: the caller never sees
+                # _started and skips stop(), which would leave the
+                # actor threads spawned above idling in their mailboxes
+                # forever. Reap them before surfacing the error.
+                try:
+                    self._teardown_partial_start()
+                except Exception:  # noqa: BLE001 - keep the cause
+                    log.error("Rank %d: partial-start teardown raised",
+                              self.rank)
+                raise
         self._started = True
         log.debug("Rank %d: multiverso started", self.rank)
         return remaining
+
+    def _teardown_partial_start(self) -> None:
+        """Stop whatever a failed start() already brought up, in the
+        same reverse order stop() uses. Only reached on the error path
+        out of start(); barriers/drains are skipped — peers may already
+        be gone."""
+        for attr in ("_serving", "_metrics_reporter", "_heartbeat",
+                     "_metrics_http"):
+            obj = getattr(self, attr)
+            if obj is not None:
+                obj.stop()
+                setattr(self, attr, None)
+        controller = self._actors.get(actors.CONTROLLER)
+        if controller is not None:
+            controller.autotune.stop()
+        for name in (actors.WORKER, actors.SERVER, actors.CONTROLLER):
+            actor = self._actors.get(name)
+            if actor is not None:
+                actor.stop()
+        comm = self._actors.get(actors.COMMUNICATOR)
+        if comm is not None:
+            comm.stop()
+        elif self._net is not None:
+            self._net.finalize()
+        self._actors.clear()
 
     def _start_observability(self) -> None:
         """Metrics export (-metrics_interval_s) + the controller-rank

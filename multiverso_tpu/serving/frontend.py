@@ -173,14 +173,17 @@ class ServingFrontend(HttpServer):
         return depth
 
     def _mailbox_report(self) -> dict:
+        # The communicator is registered in the zoo but owns no mailbox
+        # (it routes inline on caller threads; runtime/communicator.py),
+        # so only mailbox-bearing registrants report.
         report = {}
         for name in (_SERVER, _WORKER, _COMMUNICATOR):
             actor = self._zoo._actors.get(name)
-            if actor is not None:
+            mailbox = getattr(actor, "mailbox", None)
+            if mailbox is not None:
                 report[name] = {
-                    "depth": actor.mailbox.size(),
-                    "high_watermark":
-                        actor.mailbox.depth_high_watermark}
+                    "depth": mailbox.size(),
+                    "high_watermark": mailbox.depth_high_watermark}
         return report
 
     # -- routing --

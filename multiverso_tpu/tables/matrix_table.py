@@ -2239,10 +2239,10 @@ class MatrixServer(shard_map_mod.ElasticServerMixin, ServerTable):
             if self._mig_out.final_sent and epoch > self._mig_out.epoch:
                 # The controller serializes moves, so a Begin for a
                 # NEWER epoch proves the previous move committed — its
-                # broadcast merely lost a race with this Begin (the
-                # Begin rides the per-destination dispatch queue, the
-                # broadcast the communicator actor thread). Retire it;
-                # the forwarding window installed at its handoff stays.
+                # broadcast merely lost a race with this Begin (they
+                # travel different connections, so nothing orders one
+                # before the other). Retire it; the forwarding window
+                # installed at its handoff stays.
                 self._mig_out = None
             else:
                 return False

@@ -53,9 +53,7 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "coalesce_adds": True,
     "coalesce_max_msgs": 64,
     "coalesce_max_kb": 4096,
-    # -- sharding / scale-out (runtime/communicator.py,
-    #    runtime/replica.py; docs/SHARDING.md) --
-    "dispatch_queues": True,
+    # -- sharding / scale-out (runtime/replica.py; docs/SHARDING.md) --
     "replica_hot_rows": 0,
     "replica_report_gets": 256,
     "replica_min_gets": 8,

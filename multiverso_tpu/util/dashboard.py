@@ -106,9 +106,18 @@ METRIC_NAMES: Dict[str, str] = {
                         "seq gap",
     "CHAOS_DROPPED": "frames dropped by the -chaos_frames harness",
     "CHAOS_DELAYED": "frames delayed by the -chaos_frames harness",
-    # -- per-destination dispatch queues (runtime/communicator.py) --
-    "DISPATCH_MS[d*]": "per-destination dispatch queue latency (ms)",
-    "DISPATCH_QUEUE_DEPTH[d*]": "per-destination queue depth at submit",
+    # -- event-loop transport core (runtime/tcp.py; docs/THREADS.md) --
+    "DISPATCH_MS[d*]": "per-destination submit-to-wire-complete "
+                       "latency (ms) on the event loop",
+    "DISPATCH_QUEUE_DEPTH[d*]": "per-destination outbound frame-queue "
+                                "depth at submit",
+    "EVENTLOOP_TICK_MS": "event-loop tick duration (ms): one "
+                         "select-wake's worth of handler+timer work",
+    "EVENTLOOP_READY_FDS": "fds reported ready per selector wake",
+    "NET_PEER_STATE[*]": "peer state-machine transitions entered "
+                         "(CONNECTING/HANDSHAKE/READY/DRAINING/DEAD)",
+    "TRANSPORT_THREADS": "live transport threads per rank (EVENTLOOP "
+                         "+ shm WRITER); the O(1)-in-peers invariant",
     # -- observability export (runtime/metrics.py) --
     "METRICS_REPORT": "per-rank metrics snapshots shipped",
     "METRICS_DROPPED_STALE": "out-of-order/stale rank reports the "
@@ -120,9 +129,10 @@ METRIC_NAMES: Dict[str, str] = {
     "MAILBOX_DEPTH[*]": "actor mailbox depth at each push",
     # -- thread-role blocking watchdog (runtime/thread_roles.py;
     #    docs/THREADS.md) --
-    "ROLE_BLOCKED_MS[*]": "wall-clock ms a DISPATCH/LIVENESS thread "
-                          "sat blocked past -role_block_budget_ms "
-                          "(per role, -debug_locks watchdog)",
+    "ROLE_BLOCKED_MS[*]": "wall-clock ms a DISPATCH/LIVENESS/"
+                          "EVENTLOOP thread sat blocked past "
+                          "-role_block_budget_ms (per role, "
+                          "-debug_locks watchdog)",
     # -- online serving tier (serving/; docs/SERVING.md) --
     "SERVING_REQUESTS": "serving-frontend requests admitted and served",
     "SERVING_SHED": "serving-frontend requests rejected by admission",

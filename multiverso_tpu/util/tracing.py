@@ -5,7 +5,7 @@ Flag-gated per-request tracing across the PS runtime: with
 cluster-unique trace id (rank in the high bits), which travels in wire
 header slot 9 (``TRACE_SLOT``, core/message.py) on every shard, batch
 and reply message the request spawns. Each hop — worker issue, coalesce
-flush, dispatch-queue wait, tcp serialize/send, server table op, waiter
+flush, event-loop submit, tcp serialize/send, server table op, waiter
 notify — records a span event into a bounded process-local ring buffer;
 ``chrome_trace`` merges per-rank buffers into one Chrome-trace/Perfetto
 JSON where spans from different ranks pair under the request's trace id

@@ -495,49 +495,44 @@ def test_rpc_timeout_reaps_abandoned_request_state():
 
 
 # ---------------------------------------------------------------------------
-# Transport-level peer death (unit: dead writer must fail loudly)
+# Transport-level peer death (unit: a dead peer must fail loudly)
 # ---------------------------------------------------------------------------
 
-class _StubNet:
-    """Minimal TcpNet stand-in for _PeerWriter: _connect always raises,
-    so the writer thread dies on its first frame."""
+def test_dead_peer_wakes_senders_with_peer_lost():
+    """Frames queued toward an unreachable endpoint die with a typed
+    retryable PeerLostError once the nonblocking connect's retry
+    deadline expires — queued flushers wake, later submits fail fast,
+    and no thread is left parked toward the corpse."""
+    from multiverso_tpu.core.message import Blob, Message, MsgType
+    from multiverso_tpu.runtime.tcp import TcpNet
+    from multiverso_tpu.util.configure import get_flag
+    from multiverso_tpu.util.net_util import free_listen_port
 
-    rank = 0
-    _closed = False
-
-    def __init__(self):
-        self._out_locks = [threading.Lock(), threading.Lock()]
-        self.deaths = []
-
-    def _connect(self, dst):
-        raise OSError("connection refused (stub)")
-
-    def _pace(self, nbytes):
-        pass
-
-    def _count_sent(self, nbytes):
-        pass
-
-    def _peer_connection_died(self, dst, exc):
-        self.deaths.append((dst, str(exc)))
-
-
-def test_dead_peer_writer_wakes_senders_with_peer_lost():
-    from multiverso_tpu.runtime.tcp import _PeerWriter
-    net = _StubNet()
-    writer = _PeerWriter(net, dst=1)
-    # submit takes the frame as its (views, nbytes) scatter-gather pair
-    writer.submit([memoryview(b"frame-1")], 7)  # writer thread dies on it
-    deadline = time.monotonic() + 5
-    while writer.error is None and time.monotonic() < deadline:
-        time.sleep(0.01)
-    assert writer.error is not None
-    with pytest.raises(PeerLostError, match="rank 1"):
-        writer.submit([memoryview(b"frame-2")], 7)
-    with pytest.raises(PeerLostError):
-        writer.flush()
-    assert net.deaths and net.deaths[0][0] == 1
-    writer.close()
+    saved = get_flag("connect_timeout_s")
+    set_flag("connect_timeout_s", 0.4)
+    # Rank 1's endpoint is a port nobody listens on: every dial gets
+    # ECONNREFUSED and the event loop retries with backoff until the
+    # connect deadline kills the peer.
+    eps = [f"127.0.0.1:{free_listen_port()}",
+           f"127.0.0.1:{free_listen_port()}"]
+    net = TcpNet(0, eps)
+    try:
+        msg = Message(src=0, dst=1, msg_type=MsgType.Request_Add)
+        msg.push(Blob(np.zeros(16, np.float32)))
+        net.send_async(msg)
+        with pytest.raises(PeerLostError, match="rank 1"):
+            net.flush_sends(1, timeout=10.0)
+        # Death retires the peer machine: nothing queued toward the
+        # corpse, and the NEXT send starts a fresh connect cycle (the
+        # rejoin path) that dies the same loud way while the endpoint
+        # stays unreachable.
+        assert net.queue_depths().get(1, 0) == 0
+        net.send_async(msg)
+        with pytest.raises(PeerLostError, match="rank 1"):
+            net.flush_sends(1, timeout=10.0)
+    finally:
+        net.finalize()
+        set_flag("connect_timeout_s", saved)
 
 
 # ---------------------------------------------------------------------------

@@ -31,7 +31,8 @@ class TestRegistry:
             assert "::" in entry and entry.endswith(
                 tuple("abcdefghijklmnopqrstuvwxyz_"))
         assert set(thread_roles.CRITICAL_ROLES) == {
-            thread_roles.DISPATCH, thread_roles.LIVENESS}
+            thread_roles.DISPATCH, thread_roles.LIVENESS,
+            thread_roles.EVENTLOOP}
 
     def test_spawn_registers_then_unregisters(self):
         release = threading.Event()

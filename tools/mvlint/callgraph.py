@@ -50,7 +50,7 @@ DEPTH_LIMIT = 16
 
 def _ann_name(node: Optional[ast.AST]) -> Optional[str]:
     """The terminal class name an annotation spells, if any:
-    ``_PeerWriter``, ``"_PeerWriter"``, ``Optional[_PeerWriter]``."""
+    ``_ShmPeerWriter``, ``"_ShmPeerWriter"``, ``Optional[_ShmPeerWriter]``."""
     if node is None:
         return None
     if isinstance(node, ast.Name):
@@ -238,7 +238,7 @@ class CallGraph:
 
     def _rhs_class(self, rel: str, value: ast.AST) -> Optional[str]:
         """The class instantiated somewhere in an assignment RHS
-        (conditional expressions included — the dispatch-queues
+        (conditional expressions included — the flag-gated
         pattern is ``X(...) if flag else None``)."""
         for sub in ast.walk(value):
             if isinstance(sub, ast.Call):

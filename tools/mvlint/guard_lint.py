@@ -15,8 +15,7 @@ Then, in that module:
   can never name a lock the runtime witness doesn't know;
 * ``named_condition(name, lock)`` SHARES the passed lock, so the
   condition and its lock form an **alias group**: holding either
-  satisfies an annotation naming the other (the MtQueue/_DispatchQueues
-  pattern);
+  satisfies an annotation naming the other (the MtQueue pattern);
 * every read/write of ``self.<field>`` in the annotated class must
   sit under ``with <lock>`` (or ``acquire_timeout(<lock>, ...)``)
   **lexically**, or in a function whose every resolvable call site —

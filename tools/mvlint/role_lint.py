@@ -22,18 +22,22 @@ This pass is the interprocedural version, built on
   ``runtime/thread_roles.py``, and that registry must equal the
   ``docs/THREADS.md`` inventory table (the WIRE_FORMAT.md registry
   precedent: code, registry and doc can never drift apart silently).
-* **Blocking reachability** — from every DISPATCH/LIVENESS entry the
-  transitive call closure must not reach a blocking primitive:
-  blocking ``net.send``, socket ``recv``/``recv_into``/``accept``/
-  ``connect``/``create_connection``, frame reads
+* **Blocking reachability** — from every DISPATCH/LIVENESS/EVENTLOOP
+  entry the transitive call closure must not reach a blocking
+  primitive: blocking ``net.send``, socket ``recv``/``recv_into``/
+  ``accept``/``connect``/``create_connection``, frame reads
   (``_read_exact``/``_recv_into_exact``), or ``join``/``wait``/
   ``wait_for``/queue-``get`` without a timeout. ``net.recv`` (the
   communicator's inbox drain) and ``mailbox.pop`` are the *idle
-  states* of those loops, not blocking bugs, and are excluded; the
-  runtime watchdog (``-debug_locks`` + ``-role_block_budget_ms``)
-  backstops dynamically whatever this walk cannot see. Findings are
-  deduplicated per call site and report the full call path — one
-  pragma at the site covers every root that reaches it.
+  states* of those loops, not blocking bugs, and are excluded —
+  as is ``selector.select(timeout)``, the event loop's one sanctioned
+  park (its entry frame, which the watchdog reads as idle). Handler
+  calls the graph cannot resolve statically (the loop's generic
+  ``job()`` closures) are the runtime watchdog's territory
+  (``-debug_locks`` + ``-role_block_budget_ms`` backstops dynamically
+  whatever this walk cannot see). Findings are deduplicated per call
+  site and report the full call path — one pragma at the site covers
+  every root that reaches it.
 """
 
 from __future__ import annotations
@@ -47,8 +51,9 @@ from .callgraph import CallGraph, FuncInfo
 from .framework import LintPass, ModuleInfo, Violation
 from .lock_lint import _has_timeout
 
-ROLE_NAMES = ("DISPATCH", "ACTOR", "LIVENESS", "WRITER", "BACKGROUND")
-CRITICAL_ROLES = ("DISPATCH", "LIVENESS")
+ROLE_NAMES = ("DISPATCH", "ACTOR", "LIVENESS", "WRITER", "BACKGROUND",
+              "EVENTLOOP")
+CRITICAL_ROLES = ("DISPATCH", "LIVENESS", "EVENTLOOP")
 NET_NAMES = {"net", "_net"}
 
 PKG_PREFIX = "multiverso_tpu/"
@@ -394,9 +399,9 @@ class ThreadRoleLint(LintPass):
                 path, line, col, self.name,
                 f"{desc} reachable from latency-critical thread(s) "
                 f"[{', '.join(sorted(roots))}] via {rendered} — "
-                f"DISPATCH/LIVENESS threads must never block "
-                f"(docs/THREADS.md); route through send_async or a "
-                f"WRITER thread"))
+                f"DISPATCH/LIVENESS/EVENTLOOP threads must never "
+                f"block (docs/THREADS.md); route through send_async "
+                f"or an event-loop timer/queue"))
 
     def _entry_func(self, graph: CallGraph,
                     entry: str) -> Tuple[Optional[FuncInfo],
