@@ -2162,6 +2162,190 @@ def run_client_cache() -> dict:
 
 
 @flag_guarded
+def run_server_fusion() -> dict:
+    """Server-side request fusion phase (runtime/fusion.py;
+    docs/SERVER_ENGINE.md): three client ranks hammer ONE server with
+    a Zipf(1.6) Get/Add row mix — the multi-client shape where the
+    server mailbox actually backs up — over the co-located shm rings
+    and over paced localhost TCP, with fusion off (-server_fuse_max=1)
+    vs on (16). Each server dispatch is paced by an emulated tunnel
+    launch RTT (the device twin of -net_pace_mbps; this 1-core host's
+    ~40us CPU launches would otherwise drown the fixed cost fusion
+    amortizes in thread-scheduling noise). Reports rows/s, device
+    dispatches per 1k requests, fused-batch p50/p99, cross-request
+    dedup rows, and a post-run bit-identity check of a deterministic
+    read against the fusion-off arm. Acceptance: >=1.5x rows/s
+    fused-on and a >=3x dispatch cut on at least one transport."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.runtime import shm as shm_mod
+    from multiverso_tpu.runtime.cluster import LocalCluster
+    from multiverso_tpu.runtime.tcp import TcpNet
+    from multiverso_tpu.util.configure import set_flag
+    from multiverso_tpu.util.dashboard import Dashboard, samples
+    from multiverso_tpu.util.net_util import free_listen_port
+
+    world, num_row, num_col = 3, 1 << 12, 32
+    iters, per_get, window, pace_mbps = 256, 16, 32, 150.0
+    # Per-dispatch launch pacing: this host's XLA CPU launches in
+    # ~40us, but the deployment target is a TUNNELED device where the
+    # dispatch RTT runs ~1ms and swings 5-50x with tunnel weather
+    # (program_launch_ms / launch_big_ms, measured elsewhere in this
+    # bench) — the regime whose fixed cost fusion amortizes. Sleeping
+    # launch_ms inside each server dispatch is the device twin of
+    # -net_pace_mbps emulating the DCN wire; both arms pay it per
+    # PROGRAM, so the ratio isolates exactly the dispatch-count cut.
+    launch_ms = 2.0
+    ranks = np.arange(1, num_row + 1, dtype=np.float64)
+    probs = ranks ** -1.6  # Zipf(1.6): hot heads => cross-request
+    probs /= probs.sum()   # duplicate rows for the fused-Get dedup
+    n_requests = world * (iters + iters // 8)
+
+    def body(rank):
+        # Windowed async-add pipeline (the trainer push shape) with a
+        # sync Get every 4th step riding the backlog: clients keep
+        # streaming while the server drains, so the serial arm pays
+        # one dispatch per message at full mailbox pressure. The
+        # client Get register allows only ONE Get in flight per
+        # table, so the depth fusion feeds on comes from the add
+        # window — 3 clients x window deep.
+        from collections import deque
+        rng = np.random.default_rng(101 + rank)
+        table = mv.create_matrix_table(num_row, num_col, np.float32)
+        if rank == 0:
+            # Rank 0 hosts the server table ("all" role, registered
+            # inline by create): pace its two dispatch sites with the
+            # emulated tunnel launch RTT (see launch_ms above). The
+            # sleep sits where the real launch stall sits — inside
+            # the server's table-locked dispatch — and releases the
+            # GIL, exactly like a host thread blocked on the tunnel.
+            stab = mv.current_zoo()._server_tables[0]
+            real_gather = stab._gather
+            real_apply = stab._engine.apply_rows
+
+            def paced_gather(*a):
+                time.sleep(launch_ms / 1e3)
+                return real_gather(*a)
+
+            def paced_apply(*a, **kw):
+                time.sleep(launch_ms / 1e3)
+                return real_apply(*a, **kw)
+
+            stab._gather = paced_gather
+            stab._engine.apply_rows = paced_apply
+        batches = [np.unique(rng.choice(num_row, size=per_get,
+                                        p=probs)).astype(np.int32)
+                   for _ in range(iters)]
+        delta = np.ones((per_get, num_col), np.float32)
+        mv.current_zoo().barrier()
+        t0 = time.perf_counter()
+        rows = 0
+        pend = deque()
+        for i, ids in enumerate(batches):
+            pend.append(table.add_rows_async(ids, delta[:ids.size]))
+            rows += int(ids.size)
+            if len(pend) >= window:
+                table.wait(pend.popleft())
+            if i % 8 == 7:
+                table.get_rows(ids)
+                rows += int(ids.size)
+        for msg_id in pend:
+            table.wait(msg_id)
+        elapsed = time.perf_counter() - t0
+        mv.current_zoo().barrier()
+        # Post-barrier deterministic read: every client's adds are
+        # acked, so the table state is a fixed function of the seeds
+        # — the fused arm must reproduce it BIT-identically.
+        final = np.array(
+            table.get_rows(np.arange(256, dtype=np.int32)), copy=True)
+        mv.current_zoo().barrier()
+        return elapsed, rows, final
+
+    def arm(transport: str, fuse_max: int) -> dict:
+        # Pacing must be set BEFORE TcpNet construction (the writer
+        # loop samples the flag once at connect).
+        set_flag("net_pace_mbps", pace_mbps if transport == "tcp"
+                 else 0.0)
+        nets = []
+        try:
+            eps = [f"127.0.0.1:{free_listen_port()}"
+                   for _ in range(world)]
+            for r in range(world):
+                nets.append(TcpNet(r, eps))
+            if transport == "shm":
+                from multiverso_tpu.runtime.shm import ShmNet
+                nets = [ShmNet(n) for n in nets]
+                for n in nets:
+                    n.enable_shm(0x51F5, [r for r in range(world)
+                                          if r != n.rank])
+            disp0 = Dashboard.get("SERVER_DEVICE_DISPATCHES").count
+            dedup0 = Dashboard.get("SERVER_FUSE_DEDUP_ROWS").count
+            batch_mon = samples("SERVER_FUSE_BATCH")
+            batch0 = batch_mon.snapshot()["count"]
+            cluster = LocalCluster(
+                world, argv=[f"-server_fuse_max={fuse_max}"],
+                roles=["all", "worker", "worker"], nets=nets)
+            cluster.timeout = 240.0
+            res = cluster.run(body)
+            disp = Dashboard.get("SERVER_DEVICE_DISPATCHES").count \
+                - disp0
+            dedup = Dashboard.get("SERVER_FUSE_DEDUP_ROWS").count \
+                - dedup0
+            fused_batches = batch_mon.snapshot()["count"] - batch0
+            # This arm's batch sizes only: the monitor is process-
+            # global and the serial arm ran before us.
+            recent = batch_mon.export_recent(fused_batches) \
+                if fused_batches else []
+            sec = max(e for e, _, _ in res)
+            rows = sum(r for _, r, _ in res)
+            out = {"sec": round(sec, 4),
+                   "final": res[0][2],
+                   "rows_per_sec": round(rows / max(sec, 1e-9), 1),
+                   "device_dispatches": disp,
+                   "dispatches_per_1k_requests": round(
+                       disp * 1000.0 / n_requests, 1),
+                   "fused_batches": fused_batches,
+                   "dedup_rows": dedup}
+            if recent:
+                out["fused_batch_p50"] = float(
+                    np.percentile(recent, 50))
+                out["fused_batch_p99"] = float(
+                    np.percentile(recent, 99))
+            return out
+        finally:
+            for n in nets:  # idempotent: Zoo.stop finalizes the nets
+                n.finalize()  # it started; this covers setup failures
+
+    out = {"world": world, "clients": world, "num_row": num_row,
+           "num_col": num_col, "rows_per_get": per_get,
+           "iters_per_client": iters, "zipf_alpha": 1.6,
+           "tcp_pace_mbps": pace_mbps,
+           "emulated_launch_ms": launch_ms}
+    def best_of(transport: str, fuse_max: int, reps: int = 2) -> dict:
+        # Best-of-N: every virtual rank time-shares this host's single
+        # core, so one unlucky scheduler quantum can swing an arm far
+        # more than the effect under measurement.
+        runs = [arm(transport, fuse_max) for _ in range(reps)]
+        return max(runs, key=lambda r: r["rows_per_sec"])
+
+    transports = ["tcp"] + (["shm"] if shm_mod.supported() else [])
+    for transport in transports:
+        serial = best_of(transport, 1)
+        fused = best_of(transport, 16)
+        identical = bool(np.array_equal(serial.pop("final"),
+                                        fused.pop("final")))
+        out[transport] = {
+            "fuse_off": serial, "fuse_on": fused,
+            "rows_per_sec_speedup": round(
+                fused["rows_per_sec"]
+                / max(serial["rows_per_sec"], 1e-9), 3),
+            "dispatch_cut": round(
+                serial["dispatches_per_1k_requests"]
+                / max(fused["dispatches_per_1k_requests"], 1e-9), 2),
+            "gets_bit_identical": identical}
+    return out
+
+
+@flag_guarded
 def run_observability() -> dict:
     """Tracing-overhead phase (docs/OBSERVABILITY.md): the PS matrix
     Get hot path at -trace_sample_rate off / 1% / 100%, identical call
@@ -3680,6 +3864,7 @@ _PHASE_EST = {
     "tcp_one_process": 65, "tcp_two_process": 110,
     "matrix_bandwidth": 60, "local_retime": 60,
     "wire_codec": 15, "zero_copy": 45, "client_cache": 45,
+    "server_fusion": 60,
     "allreduce": 260,
     "observability": 60, "elastic": 110, "autotune": 120,
     "many_connections": 90,
@@ -3974,6 +4159,10 @@ def main() -> None:
     cache = result.run("client_cache", run_client_cache)
     if cache:
         result.merge(client_cache=cache)
+
+    fusion = result.run("server_fusion", run_server_fusion)
+    if fusion:
+        result.merge(server_fusion=fusion)
 
     obs = result.run("observability", run_observability)
     if obs:

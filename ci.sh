@@ -157,6 +157,20 @@ echo "== event-loop transport subset (peer state machines / O(1) threads) =="
 # (tests/test_event_loop.py; docs/THREADS.md).
 python -m pytest tests/test_event_loop.py -x -q
 
+echo "== server-fusion subset (mailbox drain / fused dispatch / fused == serial) =="
+# The server execution engine's request fusion gets its own named
+# gate: MtQueue.pop_batch drain semantics (high-watermark + push-side
+# track_depth sampling preserved, byte cap bounds the tail, exit
+# drains the remainder), the pure planner invariants (barrier
+# classes, per-table op exclusivity, BatchAdd all-or-nothing), the
+# dispatch protocol (arrival-order replies around barriers,
+# post-batch version stamps, PartialFuseError prefix accounting,
+# sync-mode force-disable), and the fused == serial equivalence
+# integrations across all four table types + the read-your-writes
+# floor + a -chaos_frames smoke (tests/test_server_fusion.py;
+# docs/SERVER_ENGINE.md).
+python -m pytest tests/test_server_fusion.py -x -q -m 'not slow'
+
 echo "== obs subset (tracing / metrics export / scrape surface) =="
 # Observability invariants get their own named gate: trace-id sampling
 # and wire propagation (TRACE_SLOT, byte-identity when off), the span

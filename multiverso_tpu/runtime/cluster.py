@@ -24,15 +24,22 @@ class LocalCluster:
     """Run ``fn(rank)`` on ``n`` virtual ranks, each with its own Zoo."""
 
     def __init__(self, n: int, argv: Optional[List[str]] = None,
-                 roles: Optional[List[str]] = None):
+                 roles: Optional[List[str]] = None,
+                 nets: Optional[List[Any]] = None):
         """``roles`` optionally gives one -ps_role value per rank (the flag
         registry is process-global, so heterogeneous roles are passed here
-        instead of via argv)."""
+        instead of via argv). ``nets`` optionally gives one pre-built
+        ``NetInterface`` per rank — benches use this to run the same
+        virtual cluster over real TCP/shm transports instead of the
+        default in-process ``LocalFabric``."""
         self.n = n
         self.argv = list(argv or [])
         if roles is not None and len(roles) != n:
             raise ValueError("roles must have one entry per rank")
+        if nets is not None and len(nets) != n:
+            raise ValueError("nets must have one entry per rank")
         self.roles = roles
+        self.nets = nets
         self.timeout = 120.0
 
     def run(self, fn: Callable[[int], Any]) -> List[Any]:
@@ -50,7 +57,11 @@ class LocalCluster:
                 device_lock.disable()
 
     def _run(self, fn: Callable[[int], Any]) -> List[Any]:
-        fabric = LocalFabric(self.n)
+        if self.nets is not None:
+            endpoints = list(self.nets)
+        else:
+            fabric = LocalFabric(self.n)
+            endpoints = [fabric.endpoint(r) for r in range(self.n)]
         results: List[Any] = [None] * self.n
         errors: List[Optional[BaseException]] = [None] * self.n
         zoos: List[Optional[Zoo]] = [None] * self.n
@@ -66,7 +77,7 @@ class LocalCluster:
             set_thread_zoo(zoo)
             started = False
             try:
-                zoo.start(list(self.argv), net=fabric.endpoint(rank),
+                zoo.start(list(self.argv), net=endpoints[rank],
                           role=self.roles[rank] if self.roles else None)
                 started = True
                 results[rank] = fn(rank)

@@ -25,9 +25,12 @@ from ..core.message import (PEER_LOST_MARK, Message, MsgType, mark_error,
                             stamp_version, trace_of, unpack_add_batch)
 from ..util import log, mt_queue, tracing
 from ..util.configure import define_double, get_flag
-from ..util.dashboard import monitor
+from ..util.dashboard import count, monitor, samples
 from . import actor as actors
 from . import device_lock
+# Imported eagerly so the -server_fuse_* flag definitions are
+# registered before Zoo.start parses the command line.
+from . import fusion
 from . import replica as replica_mod
 # Imported eagerly so the -snapshot_* flag definitions are registered
 # before Zoo.start parses the command line (a lazily-imported module's
@@ -154,6 +157,14 @@ class Server(Actor):
         # serve a half-constructed shard.
         self._gate_unready = bool(get_flag("rejoin"))
         self._ready_ids: set = set()
+        # Server-side request fusion (runtime/fusion.py,
+        # docs/SERVER_ENGINE.md): when the mailbox holds more than one
+        # message, drain a bounded batch and execute one device
+        # program per (table, op) group. Read at construction, like
+        # -sparse_compress; SyncServer forces max to 1 — the BSP
+        # vector clocks count one request per worker per step.
+        self._fuse_max = max(int(get_flag("server_fuse_max")), 1)
+        self._fuse_bytes = max(int(get_flag("server_fuse_bytes")), 1)
 
     def start(self) -> None:
         super().start()
@@ -214,6 +225,218 @@ class Server(Actor):
             f"{PEER_LOST_MARK} table {table_id} not (yet) registered "
             f"on rank {self._zoo.rank} — rejoin in progress?")
 
+    # -- server-side request fusion (runtime/fusion.py,
+    #    docs/SERVER_ENGINE.md) --
+    def _main(self) -> None:
+        if self._fuse_max <= 1:
+            return super()._main()
+        while True:
+            batch = self.mailbox.pop_batch(
+                self._fuse_max, self._fuse_bytes,
+                size_of=fusion.message_nbytes)
+            if not batch:
+                break
+            if len(batch) == 1:
+                self._safe_dispatch(batch[0])
+                continue
+            samples("SERVER_FUSE_BATCH").add(len(batch))
+            try:
+                self._dispatch_fused(batch)
+            except Exception:  # noqa: BLE001 - the actor must not die
+                # silently (same contract as _safe_dispatch); per-entry
+                # errors were already captured into error replies, so
+                # reaching here means the planner/reply layer itself
+                # broke — log loudly.
+                log.error("server: fused batch dispatch raised")
+                import traceback
+                traceback.print_exc()
+
+    def _dispatch_fused(self, batch: List[Message]) -> None:
+        """Execute one drained batch: eligible Get/Add/BatchAdd units
+        fuse into (table, op) groups (one device program each);
+        everything else is a barrier that dispatches through the
+        ordinary serial handler. Replies are deferred and emitted in
+        arrival order at each barrier and at batch end."""
+        infos = [fusion.classify(self, i, m)
+                 for i, m in enumerate(batch)]
+        plan = fusion.split_plan(batch, infos)
+        cursor = 0
+
+        def emit(upto: int) -> None:
+            nonlocal cursor
+            while cursor < upto:
+                if infos[cursor] is not None:
+                    self._send_fused_reply(batch[cursor], infos[cursor])
+                cursor += 1
+
+        for kind, payload in plan:
+            if kind == "serial":
+                # Every fusable message before the barrier has fully
+                # executed (split_plan flushes windows first): its
+                # replies must leave before the barrier's handler can
+                # send anything, preserving global reply order.
+                emit(payload)
+                self._safe_dispatch(batch[payload])
+                cursor = payload + 1
+            else:
+                self._run_fused_step(payload)
+        emit(len(batch))
+
+    def _run_fused_step(self, groups) -> None:
+        touched = []
+        for table, is_get, entries in groups:
+            self._run_fused_group(table, is_get, entries)
+            touched.append(table)
+        for table in touched:
+            try:
+                self._replica_flush(table)
+            except Exception:  # noqa: BLE001 - replica traffic is
+                # best-effort; the served entries' replies must still
+                # go out.
+                log.error("server: replica flush after fused group "
+                          "failed")
+                import traceback
+                traceback.print_exc()
+
+    def _run_fused_group(self, table, is_get: bool, entries) -> None:
+        """One (table, op) group, ONE device program. A failure falls
+        back to per-entry serial replay — exact serial semantics, with
+        per-entry errors captured into the deferred replies."""
+        name = "SERVER_PROCESS_GET" if is_get else "SERVER_PROCESS_ADD"
+        if len(entries) == 1:
+            # Singleton "group": the fused paths would only add
+            # overhead (a forced host materialization of the gather,
+            # dedup bookkeeping) with nothing to amortize it over —
+            # run the exact serial path; replies, stamps and metrics
+            # are identical to an unfused dispatch.
+            with monitor(name):
+                self._replay_serial(table, is_get, entries)
+            return
+        try:
+            with monitor(name):
+                if is_get:
+                    with self._lock_for(table):
+                        results = table.process_fused_get(
+                            [e.blobs for e in entries])
+                        if device_lock.active():
+                            device_lock.settle(
+                                [b.data for blobs in results
+                                 for b in blobs if b.on_device])
+                        v = table.version
+                    for e, blobs in zip(entries, results):
+                        e.result = blobs
+                        e.version = v
+                else:
+                    with self._lock_for(table):
+                        table.process_fused_add(
+                            [e.blobs for e in entries])
+                        device_lock.settle(
+                            getattr(table, "_data", None))
+                        # One bump per fused Add, all inside the lock
+                        # (snapshot consistency — see _process_add);
+                        # every reply carries the POST-BATCH version.
+                        # Conservatively LATER than the serial stamp,
+                        # which keeps read-your-writes sound: a floor
+                        # can only over-demand freshness, never admit
+                        # a stale read (docs/SERVER_ENGINE.md).
+                        table.version += len(entries)
+                        v = table.version
+                    for e in entries:
+                        e.version = v
+            if table.needs_device_lock:
+                count("SERVER_DEVICE_DISPATCHES", 1)
+        except fusion.PartialFuseError as err:
+            # The fused apply folded a prefix into table state before
+            # failing: account the prefix (version bump + stamps),
+            # then replay only the unapplied tail — replaying an
+            # applied request would double-count its delta.
+            log.error("server: fused add group failed after %d of %d "
+                      "— replaying the tail serially",
+                      err.applied, len(entries))
+            import traceback
+            traceback.print_exc()
+            if err.applied:
+                with self._lock_for(table):
+                    device_lock.settle(getattr(table, "_data", None))
+                    table.version += err.applied
+                    v = table.version
+                for e in entries[:err.applied]:
+                    e.version = v
+                if table.needs_device_lock:
+                    count("SERVER_DEVICE_DISPATCHES", 1)
+            self._replay_serial(table, is_get, entries,
+                                start=err.applied)
+        except Exception:  # noqa: BLE001
+            log.error("server: fused %s group failed — replaying "
+                      "serially", "get" if is_get else "add")
+            import traceback
+            traceback.print_exc()
+            self._replay_serial(table, is_get, entries)
+
+    def _replay_serial(self, table, is_get: bool, entries,
+                       start: int = 0) -> None:
+        """Per-entry fallback with exact serial semantics; failures
+        travel back per entry in the deferred replies."""
+        for e in entries[start:]:
+            try:
+                if is_get:
+                    with self._lock_for(table):
+                        e.result = table.process_get(e.blobs)
+                        if device_lock.active():
+                            device_lock.settle(
+                                [b.data for b in e.result
+                                 if b.on_device])
+                    e.version = table.version
+                else:
+                    with self._lock_for(table):
+                        table.process_add(e.blobs)
+                        device_lock.settle(
+                            getattr(table, "_data", None))
+                        table.version += 1
+                    e.version = table.version
+                if table.needs_device_lock:
+                    count("SERVER_DEVICE_DISPATCHES", 1)
+            except Exception as exc:  # noqa: BLE001
+                e.error = exc
+                e.version = getattr(table, "version", -1)
+                log.error("server: serial replay of fused entry "
+                          "failed (error travels in the reply)")
+                import traceback
+                traceback.print_exc()
+
+    def _send_fused_reply(self, msg: Message, entries) -> None:
+        """Emit the deferred reply for one fully-executed message:
+        the per-message Reply_Get/Reply_Add twin of the serial
+        handlers, or the reassembled Reply_BatchAdd descriptor
+        [n, (table_id, msg_id, err, version)...] + one utf-8 text
+        blob per failed sub (core/message.py pack_add_batch)."""
+        if msg.type_int == int(MsgType.Request_BatchAdd):
+            reply = msg.create_reply_message()
+            desc: List[int] = [len(entries)]
+            err_blobs: List[Blob] = []
+            for e in entries:
+                failed = e.error is not None
+                desc.extend((e.table_id, e.msg_id,
+                             1 if failed else 0, e.version))
+                if failed:
+                    text = f"{type(e.error).__name__}: {e.error}" \
+                        .encode(errors="replace")
+                    err_blobs.append(
+                        Blob(np.frombuffer(text, np.uint8).copy()))
+            reply.push(Blob(np.asarray(desc, dtype=np.int32)))
+            reply.data.extend(err_blobs)
+            self.send_to(actors.COMMUNICATOR, reply)
+            return
+        e = entries[0]
+        reply = msg.create_reply_message()
+        if e.error is not None:
+            mark_error(reply, e.error)
+        else:
+            if e.is_get:
+                reply.data = e.result
+            stamp_version(reply, e.version)
+        self.send_to(actors.COMMUNICATOR, reply)
+
     # ref: src/server.cpp:36-46
     def _process_get(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_GET"), \
@@ -259,6 +482,11 @@ class Server(Actor):
                     if device_lock.active():
                         device_lock.settle([b.data for b in reply.data
                                             if b.on_device])
+                if table.needs_device_lock:
+                    # One gather program per serial Get — the
+                    # denominator the fusion bench divides down
+                    # (docs/SERVER_ENGINE.md).
+                    count("SERVER_DEVICE_DISPATCHES", 1)
                 # Version stamp: the shard state this Get observed
                 # (client-cache freshness anchor). Error replies stay
                 # unstamped — the worker checks the error flag first.
@@ -551,6 +779,8 @@ class Server(Actor):
                     # version read, so a restore can never restore
                     # state ahead of (or behind) its recorded version.
                     table.version += 1
+                if table.needs_device_lock:
+                    count("SERVER_DEVICE_DISPATCHES", 1)
                 stamp_version(reply, table.version)
             except Exception as exc:  # noqa: BLE001
                 mark_error(reply, exc)
@@ -645,6 +875,8 @@ class Server(Actor):
                             # Inside the lock for snapshot consistency
                             # (see _process_add).
                             table.version += 1
+                        if table.needs_device_lock:
+                            count("SERVER_DEVICE_DISPATCHES", 1)
                         record(sub.table_id, sub.msg_id, None,
                                table.version)
                         touched[sub.table_id] = table
@@ -750,6 +982,15 @@ class SyncServer(Server):
 
     def __init__(self, zoo) -> None:
         super().__init__(zoo)
+        # Request fusion is force-disabled in BSP mode regardless of
+        # -server_fuse_max: the vector clocks count ONE request per
+        # worker per step, and the clock-gated caching below reorders
+        # requests in ways the fusion planner must never see
+        # (docs/SERVER_ENGINE.md).
+        if self._fuse_max > 1:
+            log.debug("sync server: request fusion force-disabled "
+                      "(BSP clock accounting)")
+        self._fuse_max = 1
         self.register_handler(MsgType.Server_Finish_Train,
                               self._process_finish_train)
         n = zoo.num_workers

@@ -476,6 +476,19 @@ class _EventLoop:
         sel = self._sel
         select_errors = 0
         while True:
+            # Re-arm the wake latch BEFORE the stop/pending checks and
+            # the park. The pipe drain below swallows every byte queued
+            # at drain time — including one written by a wake() racing
+            # this iteration — so a latch cleared mid-iteration could
+            # read True with an EMPTY pipe, suppressing every later
+            # wake: a stop() landing in that state never wakes the
+            # park and leaks this thread. Ordered this way, any wake
+            # after the re-arm writes a real byte (select returns) and
+            # any wake before it published its stop/pending state
+            # before the checks below run.
+            self._woken = False
+            if self._stopped:
+                return
             timeout = None
             if self._timers:
                 timeout = max(0.0, self._timers[0][0] - time.monotonic())
@@ -511,7 +524,6 @@ class _EventLoop:
                 return
             t0 = time.perf_counter()
             worked = bool(events)
-            self._woken = False
             jobs = None
             with self._pending_lock:
                 if self._pending:

@@ -224,6 +224,11 @@ class ArrayServer(ServerTable):
         self._engine = UpdateEngine(
             rule, (padded,), self.dtype, max(self._zoo.num_workers, 1),
             self._sharding)
+        # Host twin of the rule's linearity: only a stateless rule
+        # lets fused adds fold deltas before ONE apply
+        # (docs/SERVER_ENGINE.md; the MatrixServer precedent). No rule
+        # means plain accumulation — linear by construction.
+        self._updater_stateless = True if rule is None else rule.stateless
 
     # -- server logic (ref: array_table.cpp:116-141) --
     def process_add(self, blobs: List[Blob]) -> None:
@@ -239,6 +244,66 @@ class ArrayServer(ServerTable):
         CHECK(key == -1, "array table only serves whole-table gets")
         return [Blob(np.array([self.server_id], dtype=np.int32)),
                 Blob(self._values())]
+
+    # -- server-side request fusion (runtime/fusion.py,
+    #    docs/SERVER_ENGINE.md; always entered under Server._lock_for)
+    def fuse_eligible(self, blobs: List[Blob], is_get: bool) -> bool:
+        """Whole-table host requests only: a Get must carry the -1
+        sentinel (anything else raises in process_get — keep that on
+        the serial path), an Add must carry a host delta and a
+        stateless rule (fused adds FOLD deltas before one apply, which
+        is only sum-equivalent for linear updates)."""
+        if not blobs or blobs[0].on_device:
+            return False
+        if is_get:
+            return blobs[0].size >= 4 \
+                and int(blobs[0].as_array(np.int32)[0]) == -1
+        if len(blobs) not in (2, 3) or blobs[1].on_device:
+            return False
+        return self._updater_stateless
+
+    def process_fused_get(self, requests: List[List[Blob]]
+                          ) -> List[List[Blob]]:
+        """N whole-table Gets, ONE snapshot program: every reply
+        shares the fresh copy (read-only on the reply path).
+        Bit-identical to serial — the serial loop copies the same
+        device state N times."""
+        values = self._values()
+        return [[Blob(np.array([self.server_id], dtype=np.int32)),
+                 Blob(values)] for _ in requests]
+
+    def process_fused_add(self, requests: List[List[Blob]]) -> None:
+        """N dense Adds, ONE apply per option sub-group: left-fold the
+        host deltas in arrival order, then apply once — linear for
+        stateless rules, so sum-equivalent to the serial loop.
+        Parse-first contract (table_interface.py): every delta is
+        validated before the first apply."""
+        runs: List[tuple] = []  # (option bytes, option, [deltas])
+        for blobs in requests:
+            CHECK(len(blobs) in (2, 3),
+                  "add needs [keys, values(, option)]")
+            option = AddOption.from_blob(blobs[2]) \
+                if len(blobs) == 3 else None
+            okey = blobs[2].as_array(np.uint8).tobytes() \
+                if len(blobs) == 3 else None
+            delta = np.asarray(blobs[1].typed(self.dtype)).ravel()
+            CHECK(delta.size == self.size,
+                  "add delta shard size mismatch")
+            if not runs or runs[-1][0] != okey:
+                runs.append((okey, option, []))
+            runs[-1][2].append(delta)
+        applied = 0
+        for _, option, deltas in runs:
+            try:
+                acc = deltas[0].astype(self.dtype, copy=True)
+                for d in deltas[1:]:
+                    acc += d
+                self._data = self._engine.apply_dense(self._data, acc,
+                                                      option)
+            except Exception as exc:  # noqa: BLE001
+                from ..runtime.fusion import PartialFuseError
+                raise PartialFuseError(applied, exc) from exc
+            applied += len(deltas)
 
     def _values(self):
         """Logical-size snapshot of the padded device shard. Always a fresh

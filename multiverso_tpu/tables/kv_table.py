@@ -290,6 +290,19 @@ class KVServer(shard_map_mod.ElasticServerMixin, ServerTable):
                           dtype=self.val_dtype)
         return [blobs[0], Blob(values.view(np.uint8))]
 
+    # -- server-side request fusion (runtime/fusion.py) --
+    def fuse_eligible(self, blobs: List[Blob], is_get: bool) -> bool:
+        """Host-dict table: fusion is just the base-class serial loop
+        under one dispatch, so any steady-state request qualifies.
+        Opt out whenever elastic state is live — forwarding windows,
+        in/out migrations or a pending-delta ledger re-route or defer
+        individual requests, and those paths must keep their serial
+        retryable-NACK semantics."""
+        if blobs and blobs[0].on_device:
+            return False
+        return not (self._fwd or self._mig_in
+                    or self._mig_out is not None or self._pending)
+
     # -- elastic resharding: server side (runtime/shard_map.py) --
     def shard_begin_out(self, desc) -> bool:
         lo, hi, src_sid, dst_sid, dst_rank, epoch = (

@@ -1952,6 +1952,117 @@ class MatrixServer(shard_map_mod.ElasticServerMixin, ServerTable):
                 self._up_to_date[opt.worker_id, local_rows] = True
         return [blobs[0]] + self._reply_values(values)
 
+    # -- server-side request fusion (runtime/fusion.py,
+    #    docs/SERVER_ENGINE.md; always entered under Server._lock_for,
+    #    like process_add/process_get above) --
+    def fuse_eligible(self, blobs: List[Blob], is_get: bool) -> bool:
+        """Plain row-keyed host requests only. Every excluded layout
+        carries per-request semantics the fused paths do not
+        reproduce: device-key blobs (masking + device replies),
+        sentinel protocols (-1/-2/-4 whole-table and dirty gets),
+        codec frames and 1-bit pushes (per-request decode), elastic
+        windows (row-level routing/NACKs), replica-routed foreign
+        rows (host-store serve + repair descriptors), and stateful
+        updaters (duplicate ids across requests must SUM inside one
+        program — only stateless rules guarantee that,
+        updater/engine.py apply_rows)."""
+        if not blobs or blobs[0].on_device or self._elastic_active():
+            return False
+        keys = blobs[0].as_array(np.int32)
+        if keys.size == 0 or int(keys[0]) < 0:
+            return False
+        if is_get:
+            if self._replica is None:
+                return True
+            own = (keys >= self.row_offset) \
+                & (keys < self.row_offset + self.my_rows)
+            return bool(own.all())
+        if not self._updater_stateless:
+            return False
+        if len(blobs) not in (2, 3) or blobs[1].on_device:
+            return False
+        if self._compress and _is_codec_blob(blobs[1]):
+            return False
+        return True
+
+    def process_fused_get(self, requests: List[List[Blob]]
+                          ) -> List[List[Blob]]:
+        """N row Gets, ONE gather: concatenate the keys, dedup rows
+        requested by more than one client (each gathers once —
+        SERVER_FUSE_DEDUP_ROWS counts the savings), pad to the bucket
+        grid and run the SAME cached gather program the serial path
+        uses, then slice per request through the dedup inverse.
+        Bit-identical to serial: gather-with-fill over identical row
+        ids yields identical bits, and the per-request bookkeeping
+        (hot tracking, replica read notes, the sparse staleness
+        bitmap) replays per request below, in arrival order."""
+        keys_list = [blobs[0].as_array(np.int32) for blobs in requests]
+        local = np.concatenate(keys_list) - self.row_offset
+        uniq, inverse = np.unique(local, return_inverse=True)
+        count_event("SERVER_FUSE_DEDUP_ROWS",
+                    int(local.size) - int(uniq.size))
+        padded = pad_ids(uniq, self._data.shape[0])
+        values = np.asarray(_trim_rows(self._gather(self._data, padded),
+                                       uniq.size))
+        out: List[List[Blob]] = []
+        pos = 0
+        for blobs, keys in zip(requests, keys_list):
+            sel = inverse[pos:pos + keys.size]
+            pos += keys.size
+            if self._hot is not None:
+                self._hot.note(keys)
+            if self._replica is not None:
+                self._replica.note_get(keys)
+            if self._up_to_date is not None and len(blobs) >= 2:
+                opt = GetOption.from_blob(blobs[1])
+                if 0 <= opt.worker_id < self._up_to_date.shape[0]:
+                    self._up_to_date[opt.worker_id,
+                                     keys - self.row_offset] = True
+            out.append([blobs[0]] + self._reply_values(values[sel]))
+        return out
+
+    def process_fused_add(self, requests: List[List[Blob]]) -> None:
+        """N row Adds, ONE scatter per option sub-group: stateless
+        rules SUM duplicate ids inside one program (updater/engine.py
+        apply_rows), so concatenation is sum-equivalent to the serial
+        left fold; requests carrying different option bytes (the rule
+        scales the delta by per-request hyperparameters, and the
+        dirty bitmap keys on the adder's worker id) split into
+        ordered sub-groups. Parse-first contract
+        (table_interface.py): every request decodes and reshapes
+        before the first apply; a later apply failing raises
+        PartialFuseError with the applied request count."""
+        runs: List[tuple] = []  # (option bytes, option, [(keys, delta)])
+        for blobs in requests:
+            keys = blobs[0].as_array(np.int32)
+            option = AddOption.from_blob(blobs[2]) \
+                if len(blobs) == 3 else None
+            okey = blobs[2].as_array(np.uint8).tobytes() \
+                if len(blobs) == 3 else None
+            delta = np.asarray(blobs[1].typed(self.dtype)).reshape(
+                keys.size, self.num_col)
+            if not runs or runs[-1][0] != okey:
+                runs.append((okey, option, []))
+            runs[-1][2].append((keys, delta))
+        applied = 0
+        for _, option, items in runs:
+            try:
+                all_keys = np.concatenate([k for k, _ in items])
+                local = (all_keys - self.row_offset).astype(np.int32)
+                delta = np.ascontiguousarray(
+                    np.concatenate([d for _, d in items]))
+                self._data = self._engine.apply_rows(
+                    self._data, local, delta, option)
+            except Exception as exc:  # noqa: BLE001
+                from ..runtime.fusion import PartialFuseError
+                raise PartialFuseError(applied, exc) from exc
+            for keys, _ in items:
+                applied += 1
+                if self._up_to_date is not None:
+                    self._mark_dirty(keys - self.row_offset, option)
+                if self._replica is not None:
+                    self._replica.note_add(keys)
+
     # -- hot-shard replication: holder/owner server sides
     #    (runtime/replica.py, docs/SHARDING.md) --
     def _replica_row_get(self, keys: np.ndarray,

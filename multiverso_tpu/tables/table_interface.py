@@ -626,6 +626,45 @@ class ServerTable:
     def process_get(self, blobs: List[Blob]) -> List[Blob]:
         raise NotImplementedError
 
+    # -- server-side request fusion hooks (runtime/fusion.py,
+    #    docs/SERVER_ENGINE.md; server actor thread only, always
+    #    entered under Server._lock_for) --
+    def fuse_eligible(self, blobs: List[Blob], is_get: bool) -> bool:
+        """May this request join a fused (table, op) group? Default
+        NO: a table type must opt in per request — sentinel keys,
+        device-key blobs, wire-codec frames, elastic windows and
+        replica-routed rows all carry per-request semantics the fused
+        paths do not reproduce. Called on the server actor thread at
+        batch-classification time; nothing else touches table state
+        between the check and the fused execution."""
+        return False
+
+    def process_fused_get(self, requests: List[List[Blob]]
+                          ) -> List[List[Blob]]:
+        """Serve N eligible Gets as one unit — ONE device program
+        where the table type supports it. Returns one reply blob-list
+        per request, in request order; MUST be bit-identical to
+        serving each request through ``process_get`` serially.
+        Default: the serial loop (host-only tables lose nothing)."""
+        return [self.process_get(blobs) for blobs in requests]
+
+    def process_fused_add(self, requests: List[List[Blob]]) -> None:
+        """Apply N eligible Adds as one unit — sum-equivalent (left
+        fold in request order) to serial ``process_add``. The caller
+        bumps ``version`` by len(requests) and stamps every reply
+        with the post-batch version. Contract: either parse/validate
+        every request BEFORE the first state mutation (so a plain
+        exception means nothing applied and the caller replays the
+        whole group serially), or raise ``fusion.PartialFuseError``
+        naming the applied prefix — the caller then replays only the
+        tail. The default serial loop keeps that accounting exact."""
+        for i, blobs in enumerate(requests):
+            try:
+                self.process_add(blobs)
+            except Exception as exc:  # noqa: BLE001
+                from ..runtime.fusion import PartialFuseError
+                raise PartialFuseError(i, exc) from exc
+
     # -- elastic resharding hooks (runtime/shard_map.py,
     #    docs/SHARDING.md; server actor thread only). Default: table
     #    types that do not support live migration refuse/ignore —

@@ -50,6 +50,8 @@ CANONICAL_FLAGS: Dict[str, Any] = {
     "sync": False,
     # -- server / worker actors --
     "backup_worker_ratio": 0.0,
+    "server_fuse_max": 16,
+    "server_fuse_bytes": 16777216,
     "coalesce_adds": True,
     "coalesce_max_msgs": 64,
     "coalesce_max_kb": 4096,

@@ -38,6 +38,13 @@ METRIC_NAMES: Dict[str, str] = {
     "SERVER_PROCESS_GET": "server-side Get table op + reply",
     "SERVER_PROCESS_ADD": "server-side Add apply + ack",
     "SERVER_PROCESS_BATCH_ADD": "server-side coalesced batch apply",
+    # -- server request fusion (runtime/fusion.py; docs/SERVER_ENGINE.md) --
+    "SERVER_FUSE_BATCH": "fused mailbox batch sizes (messages drained "
+                         "per dispatch; sampled only when > 1)",
+    "SERVER_DEVICE_DISPATCHES": "device programs dispatched by server "
+                                "table ops (serial + fused paths)",
+    "SERVER_FUSE_DEDUP_ROWS": "cross-request duplicate rows gathered "
+                              "once by a fused Get",
     # -- model / collective stalls --
     "PS_GET_STALL": "trainer blocked on a parameter Get (prefetch miss)",
     "MA_COMM_STALL": "model-average blocked on the collective",

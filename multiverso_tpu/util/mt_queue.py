@@ -12,7 +12,8 @@ from __future__ import annotations
 import collections
 import itertools
 import time
-from typing import Deque, Generic, Optional, Tuple, TypeVar
+from typing import (Callable, Deque, Generic, List, Optional, Tuple,
+                    TypeVar)
 
 from .configure import get_flag
 from .dashboard import samples
@@ -104,6 +105,49 @@ class MtQueue(Generic[T]):
             if self._buffer:
                 return self._buffer.popleft()
             return None
+
+    def pop_batch(self, max_items: int = 64,
+                  max_bytes: Optional[int] = None,
+                  size_of: Optional[Callable[[T], int]] = None,
+                  timeout: Optional[float] = None) -> List[T]:
+        """Bounded atomic drain (server request fusion,
+        docs/SERVER_ENGINE.md): block like ``pop`` for the FIRST item,
+        then take whatever else is already queued — no further waiting
+        — up to ``max_items`` and, when ``size_of`` is given, up to
+        ``max_bytes`` of summed item size. The first item is always
+        taken regardless of its size (the one-message fallback: an
+        oversized request must still make progress), so the byte cap
+        bounds the batch TAIL, not a single message. Returns ``[]``
+        only on exit/timeout.
+
+        Depth semantics match ``pop``: the high watermark is a
+        push-side property and is untouched here, and ``track_depth``
+        sampling stays push-only — a drain never writes the reservoir.
+        """
+        max_items = max(int(max_items), 1)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._buffer and not self._exit:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return []
+                if not self._cond.wait(timeout=remaining):
+                    return []
+            if not self._buffer:
+                return []
+            batch: List[T] = [self._buffer.popleft()]
+            budget = None
+            if max_bytes is not None and size_of is not None:
+                budget = max(int(max_bytes), 0) - size_of(batch[0])
+            while self._buffer and len(batch) < max_items:
+                if budget is not None:
+                    nxt = size_of(self._buffer[0])
+                    if budget - nxt < 0:
+                        break
+                    budget -= nxt
+                batch.append(self._buffer.popleft())
+            return batch
 
     def try_pop(self) -> Tuple[bool, Optional[T]]:
         with self._mutex:

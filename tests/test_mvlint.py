@@ -65,6 +65,23 @@ class TestFixtures:
                 or "# C" in src[line - 1], src[line - 1]
         assert result.per_pass_suppressed["device-dispatch"] == 1
 
+    def test_fused_device_dispatch_seeded(self):
+        # PR-19 regression fixture: fused dispatch sites (one device
+        # program for MANY requests, runtime/fusion.py) are ordinary
+        # call sites to the pass — an unguarded fused concat+gather is
+        # flagged exactly like a serial one, and the _lock_for guard
+        # Server._run_fused_group holds keeps the real path silent.
+        result = _fixture_result("bad_fused_device_train.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "device-dispatch"]
+        assert len(found) == 2, [v.render() for v in found]
+        src = (FIXTURES / "bad_fused_device_train.py") \
+            .read_text().splitlines()
+        for line in sorted(v.line for v in found):
+            assert "# D" in src[line - 1] or "# E" in src[line - 1], \
+                src[line - 1]
+        assert result.per_pass_suppressed["device-dispatch"] == 1
+
     def test_lock_discipline_seeded(self):
         result = _fixture_result("bad_locks.py")
         found = [v for v in result.violations
@@ -175,8 +192,8 @@ class TestFixtures:
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 35
-        assert len(result.suppressed) == 10
+        assert len(result.violations) == 37
+        assert len(result.suppressed) == 11
 
 
 class TestCleanTree:

@@ -1,0 +1,35 @@
+"""device-dispatch fixture for server-side request fusion (filename
+ends in device_train.py so the pass scopes it). Never imported, only
+parsed.
+
+A fused dispatch site gathers rows for MANY requests in one device
+program (runtime/fusion.py; docs/SERVER_ENGINE.md) — so an unguarded
+fused gather races every request in the batch at once. The pass must
+see fused call sites exactly like serial ones.
+
+Expected findings:
+  line D: unguarded fused concat+gather dispatch -> violation
+  line E: unguarded device_put of fused ids      -> violation
+Clean: the fused group body under `with self._lock_for(table):` (the
+guard Server._run_fused_group actually holds), and a whole-def pragma
+on a fused helper.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_gather_bad(self, requests):
+    ids = jnp.concatenate([r.keys for r in requests])        # D
+    padded = jax.device_put(ids)                             # E
+    return self._gather(self._data, padded)
+
+
+def fused_gather_guarded(self, table, requests):
+    with self._lock_for(table):
+        ids = jnp.concatenate([r.keys for r in requests])
+        return self._gather(self._data, ids)
+
+
+def fused_scatter_caller_holds(self, stacked):  # mvlint: ignore[device-dispatch]
+    return jnp.sum(stacked, axis=0)             # clean: whole-def pragma
