@@ -12,8 +12,12 @@ echo "== mvlint static-analysis gate =="
 # zero-copy wire path (cross-checked vs docs/MEMORY.md),
 # interprocedural thread-role blocking reachability (cross-checked vs
 # docs/THREADS.md + the THREAD_ROLES registry; runtime twin is the
-# -debug_locks/-role_block_budget_ms watchdog) and guarded-by
-# field/lock annotations — ten passes total. Fails on any non-pragma'd
+# -debug_locks/-role_block_budget_ms watchdog), guarded-by field/lock
+# annotations, message-protocol flow (every Request reaches exactly
+# one handler, every reply path counts the requester's Waiter down,
+# cross-checked vs the docs/WIRE_FORMAT.md flow table both
+# directions) and the wake-latch re-arm ordering (the PR-19 lost-
+# wakeup shape) — twelve passes total. Fails on any non-pragma'd
 # violation and prints file:line diagnostics; the trailing summary
 # shows per-pass counts. (`python -m tools.mvlint --baseline ...`
 # prints the same counts WITHOUT failing — drift-at-a-glance for PRs.)
@@ -39,6 +43,22 @@ if [ "$rc" -ne 1 ]; then
     cat /tmp/mv_lint_fix.log
     echo "FATAL: mvlint fixtures self-check expected exit 1, got $rc"
     exit 1
+fi
+
+echo "== mvchk model-checker gate (systematic schedules) =="
+# The dynamic half of the concurrency gate (docs/STATIC_ANALYSIS.md
+# "The dynamic half"): deterministic bounded-preemption exploration of
+# the real MtQueue/Waiter/_VectorClock primitives on model locks, plus
+# the event-loop wake protocol. The exit code is the expectation check
+# both ways — every good spec must pass ALL explored schedules AND the
+# known-bad pre-PR-19 wake-drain ordering must be REFUTED with a
+# printed counterexample trace; a checker that blesses it has gone
+# vacuous and fails here, the same self-check discipline as the mvlint
+# fixtures above. Seeded-random long runs ride the slow gate.
+python -m tools.mvchk
+if [ "${MV_CI_SLOW:-0}" = "1" ]; then
+    echo "== mvchk soak (seeded-random schedules) =="
+    python -m tools.mvchk --random 300 --seed 20260807
 fi
 
 echo "== build native (c_api shim) from source =="

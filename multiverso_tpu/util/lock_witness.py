@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional, Tuple
 
@@ -306,17 +307,55 @@ class _WitnessCondition:
         self._cond.notify_all()
 
 
+# -- injectable thread model (tools/mvchk) --
+
+#: When a model is installed, the factories below build ITS
+#: cooperative primitives instead of ``threading``'s, and
+#: :func:`monotonic` reads its virtual clock — that is the entire
+#: hook surface the mvchk deterministic-schedule checker needs to run
+#: MtQueue/Waiter under controlled interleavings. Sampled at
+#: CONSTRUCTION time like ``-debug_locks``: primitives built while no
+#: model is installed are plain ``threading`` objects with zero
+#: steady-state overhead.
+_THREAD_MODEL = None
+
+
+def install_thread_model(model) -> None:
+    """``model`` provides ``lock(name)``, ``rlock(name)``,
+    ``condition(name, lock)`` and ``monotonic()``."""
+    global _THREAD_MODEL
+    _THREAD_MODEL = model
+
+
+def clear_thread_model() -> None:
+    global _THREAD_MODEL
+    _THREAD_MODEL = None
+
+
+def monotonic() -> float:
+    """``time.monotonic()``, or the installed model's virtual clock —
+    deadline math in the primitives routes through here so a model
+    checker can expire timeouts deterministically."""
+    if _THREAD_MODEL is not None:
+        return _THREAD_MODEL.monotonic()
+    return time.monotonic()
+
+
 # -- factories (the only public construction path) --
 
 def named_lock(name: str):
     """A ``threading.Lock`` — witness-wrapped iff -debug_locks is set
     at the moment of construction."""
+    if _THREAD_MODEL is not None:
+        return _THREAD_MODEL.lock(name)
     if enabled():
         return _WitnessLock(threading.Lock(), name)
     return threading.Lock()
 
 
 def named_rlock(name: str):
+    if _THREAD_MODEL is not None:
+        return _THREAD_MODEL.rlock(name)
     if enabled():
         return _WitnessLock(threading.RLock(), name)
     return threading.RLock()
@@ -326,6 +365,8 @@ def named_condition(name: str, lock=None):
     """A ``threading.Condition``. Pass ``lock`` to share a mutex the
     way ``threading.Condition(mutex)`` does — a ``named_lock`` result
     (plain or witnessed) is accepted."""
+    if _THREAD_MODEL is not None:
+        return _THREAD_MODEL.condition(name, lock)
     if enabled() or isinstance(lock, _WitnessLock):
         return _WitnessCondition(name, lock)
     return threading.Condition(lock)

@@ -11,13 +11,12 @@ from __future__ import annotations
 
 import collections
 import itertools
-import time
 from typing import (Callable, Deque, Generic, List, Optional, Tuple,
                     TypeVar)
 
 from .configure import get_flag
 from .dashboard import samples
-from .lock_witness import named_condition, named_lock
+from .lock_witness import monotonic, named_condition, named_lock
 
 T = TypeVar("T")
 
@@ -93,11 +92,11 @@ class MtQueue(Generic[T]):
 
     def pop(self, timeout: Optional[float] = None) -> Optional[T]:
         """Block until an item is available; None once exited (or timeout)."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else monotonic() + timeout
         with self._cond:
             while not self._buffer and not self._exit:
                 remaining = None if deadline is None \
-                    else deadline - time.monotonic()
+                    else deadline - monotonic()
                 if remaining is not None and remaining <= 0:
                     return None
                 if not self._cond.wait(timeout=remaining):
@@ -125,11 +124,11 @@ class MtQueue(Generic[T]):
         sampling stays push-only — a drain never writes the reservoir.
         """
         max_items = max(int(max_items), 1)
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else monotonic() + timeout
         with self._cond:
             while not self._buffer and not self._exit:
                 remaining = None if deadline is None \
-                    else deadline - time.monotonic()
+                    else deadline - monotonic()
                 if remaining is not None and remaining <= 0:
                     return []
                 if not self._cond.wait(timeout=remaining):

@@ -8,9 +8,8 @@ TPU-native equivalent of the reference's ``Waiter``
 from __future__ import annotations
 
 import itertools
-import time
 
-from .lock_witness import named_condition, named_lock
+from .lock_witness import monotonic, named_condition, named_lock
 
 _serial = itertools.count()
 
@@ -23,11 +22,11 @@ class Waiter:
         self._num_wait = num_wait
 
     def wait(self, timeout=None) -> bool:
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else monotonic() + timeout
         with self._cond:
             while self._num_wait > 0:
                 remaining = None if deadline is None \
-                    else deadline - time.monotonic()
+                    else deadline - monotonic()
                 if remaining is not None and remaining <= 0:
                     return False
                 if not self._cond.wait(timeout=remaining):

@@ -1,0 +1,158 @@
+"""Self-tests for the mvchk deterministic-schedule model checker
+(tools/mvchk) — the dynamic half of the PR-20 concurrency gate.
+
+The checker is regression-protected the same way the mvlint fixtures
+are: every good spec must keep passing bounded exploration, and the
+known-bad pre-PR-19 event-loop ordering must keep being REFUTED with
+a readable counterexample — a checker that blesses it has gone
+vacuous and these tests fail loudly.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+from multiverso_tpu.util import lock_witness
+from tools.mvchk import (ALL_SPECS, SPECS_BY_NAME, Deadlock, explore,
+                         format_trace, run_once, soak)
+from tools.mvchk.core import MLock, Scheduler, SchedVar
+
+
+class TestScheduler:
+    def test_single_task_runs_to_completion(self):
+        hits = []
+
+        def body(sched):
+            def t():
+                hits.append(sched.current_task().name)
+            sched.spawn("solo", t)
+
+        from tools.mvchk.core import Spec
+        out = run_once(Spec("solo", "one task", body))
+        assert out.ok, out.error
+        assert hits == ["solo"]
+
+    def test_deadlock_names_the_blocked_task(self):
+        def body(sched):
+            def t():
+                sched.yield_point("park forever", pred=lambda: False)
+            sched.spawn("blocked", t)
+
+        from tools.mvchk.core import Spec
+        out = run_once(Spec("dl", "deadlock", body))
+        assert not out.ok
+        assert isinstance(out.error, Deadlock)
+        assert "blocked" in str(out.error)
+        assert "park forever" in str(out.error)
+
+    def test_virtual_time_expires_timeouts(self):
+        """A timed wait on a dead condition expires via vtime — no
+        wall-clock sleep, so the run is instant."""
+        results = []
+
+        def body(sched):
+            def t():
+                timed_out = sched.yield_point(
+                    "park", pred=lambda: False, timeout_ok=True)
+                results.append(timed_out)
+            sched.spawn("sleeper", t)
+
+        from tools.mvchk.core import Spec
+        out = run_once(Spec("vt", "vtime", body))
+        assert out.ok, out.error
+        assert results == [True]
+
+    def test_no_thread_model_residue_after_run(self):
+        """run_once installs the model facade around setup+run and must
+        clear it even though specs construct real MtQueue/Waiter
+        objects: a leaked model would poison every later test."""
+        out = run_once(SPECS_BY_NAME["mtqueue-exit-drain"])
+        assert out.ok, out.error
+        assert lock_witness._THREAD_MODEL is None
+        # Fresh primitives bind real threading locks again.
+        from multiverso_tpu.util.mt_queue import MtQueue
+        q = MtQueue("residue-probe")
+        assert not isinstance(q._mutex, MLock)
+        q.exit()
+
+
+class TestSpecs:
+    @pytest.mark.parametrize(
+        "name", [s.name for s in ALL_SPECS if not s.expect_fail])
+    def test_good_spec_passes_systematic(self, name):
+        result = explore(SPECS_BY_NAME[name], max_schedules=600)
+        if result.refuted:
+            pytest.fail(f"{name} refuted:\n"
+                        f"{format_trace(result.counterexample)}")
+        assert result.schedules >= 1
+
+    def test_known_bad_is_refuted_with_readable_trace(self):
+        """THE self-check: the explorer must reproduce the pre-PR-19
+        lost wakeup (stopper reads a stale latch, skips the wake byte;
+        the loop re-arms and parks on an empty pipe)."""
+        result = explore(SPECS_BY_NAME["event-loop-pre-pr19"])
+        assert result.refuted, (
+            "checker lost the known-bad counterexample")
+        trace = format_trace(result.counterexample)
+        assert "Deadlock" in trace
+        assert "select(wakepipe)" in trace
+        # The schedule itself is recorded, so the refutation replays.
+        assert result.counterexample.schedule
+
+    def test_counterexample_replays_deterministically(self):
+        result = explore(SPECS_BY_NAME["event-loop-pre-pr19"])
+        sched = result.counterexample.schedule
+        replay = run_once(SPECS_BY_NAME["event-loop-pre-pr19"],
+                          prefix=sched)
+        assert not replay.ok
+        assert isinstance(replay.error, Deadlock)
+
+    def test_good_event_loop_survives_soak(self):
+        result = soak(SPECS_BY_NAME["event-loop-wake"], runs=25,
+                      seed=1234)
+        if result.refuted:
+            pytest.fail(format_trace(result.counterexample))
+
+    def test_soak_finds_the_known_bad_eventually(self):
+        # Random search is weaker than systematic but the window is
+        # wide enough that a modest soak still lands in it.
+        result = soak(SPECS_BY_NAME["event-loop-pre-pr19"], runs=200,
+                      seed=99)
+        assert result.refuted
+
+
+class TestCli:
+    def test_module_entrypoint_known_bad_gate(self):
+        """`python -m tools.mvchk` is the CI gate: exit 0 means every
+        good spec passed AND the known-bad spec was refuted."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mvchk",
+             "--spec", "mtqueue-exit-drain",
+             "--spec", "event-loop-pre-pr19"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "refuted as required" in proc.stdout
+        assert "step" in proc.stdout  # the readable trace printed
+
+    def test_module_entrypoint_lists_specs(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mvchk", "--list"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0
+        assert "event-loop-pre-pr19" in proc.stdout
+        assert "[known-bad]" in proc.stdout
+
+
+@pytest.mark.slow
+class TestSoakSlow:
+    def test_long_soak_all_good_specs(self):
+        for spec in ALL_SPECS:
+            if spec.expect_fail:
+                continue
+            result = soak(spec, runs=300, seed=20260807)
+            if result.refuted:
+                pytest.fail(f"{spec.name}:\n"
+                            f"{format_trace(result.counterexample)}")

@@ -188,12 +188,45 @@ class TestFixtures:
         assert "_bump" not in messages
         assert result.per_pass_suppressed["guarded-by"] == 1
 
+    def test_msg_flow_seeded(self):
+        result = _fixture_result("bad_msg_flow.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "msg-flow"]
+        assert len(found) == 4, [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        # Duplicate registration names the shadowed first site.
+        assert "duplicate register_handler" in messages
+        assert "first at tools/mvlint/fixtures/bad_msg_flow.py:24" \
+            in messages
+        # Reply handler that never counts the waiter down.
+        assert "never reaches Waiter.notify/release" in messages
+        # Reply handler that ignores the error path.
+        assert "never inspects take_error()" in messages
+        # Request nobody answers.
+        assert "none reaches create_reply_message()" in messages
+        assert result.per_pass_suppressed["msg-flow"] == 1
+
+    def test_wake_protocol_seeded(self):
+        result = _fixture_result("bad_wake_protocol.py")
+        found = [v for v in result.violations
+                 if v.pass_name == "wake-protocol"]
+        assert len(found) == 3, [v.render() for v in found]
+        lines = sorted(v.line for v in found)
+        assert lines == [39, 58, 74], [v.render() for v in found]
+        messages = "\n".join(v.message for v in found)
+        assert "re-armed AFTER a state check" in messages
+        assert "re-armed AFTER the park" in messages
+        assert "never re-arms wake latch" in messages
+        # Every diagnostic teaches the fix, not just the fault.
+        assert "re-arm first, then check state, then park" in messages
+        assert result.per_pass_suppressed["wake-protocol"] == 1
+
     def test_fixture_dir_fails_as_a_whole(self):
         result = run_passes(build_passes(REPO_ROOT), [str(FIXTURES)],
                             REPO_ROOT)
         assert result.failed
-        assert len(result.violations) == 37
-        assert len(result.suppressed) == 11
+        assert len(result.violations) == 44
+        assert len(result.suppressed) == 13
 
 
 class TestCleanTree:
@@ -228,6 +261,48 @@ class TestCleanTree:
         messages = [v.message for v in lint.check(module)]
         assert any("Request_Add=2 missing" in m for m in messages)
         assert any("Ghost_Type" in m for m in messages)
+
+    def test_doc_flow_table_covers_every_msg_type(self):
+        from multiverso_tpu.core.message import MsgType
+        from tools.mvlint.msg_flow_lint import load_flow_table
+        flow = load_flow_table(REPO_ROOT / "docs" / "WIRE_FORMAT.md")
+        assert set(flow) == {t.name for t in MsgType}
+        for name, (kind, paired, _handlers, _line) in flow.items():
+            assert kind in {"request", "reply", "fire-and-forget"}, name
+            if kind == "request":
+                # Every request names its reply, and the reply row
+                # agrees — pairing is by table, not value arithmetic
+                # (Request_FwdGet=9 pairs Reply_Get=-1).
+                assert paired in flow, name
+                assert flow[paired][0] == "reply", name
+
+    def test_flow_table_doc_drift_is_a_violation(self):
+        # Both directions fire: a MsgType with no flow row, and a
+        # stale flow row naming no MsgType member.
+        lint = next(p for p in build_passes(REPO_ROOT)
+                    if p.name == "msg-flow")
+        lint.flow = dict(lint.flow)
+        del lint.flow["Request_Get"]
+        lint.flow["Ghost_Message"] = ("fire-and-forget", None, (), 1)
+        messages = [v.message for v in lint._doc_checks()]
+        assert any("MsgType.Request_Get" in m and "no row" in m
+                   for m in messages)
+        assert any("Ghost_Message" in m and "no MsgType member" in m
+                   for m in messages)
+
+    def test_flow_table_handler_drift_is_a_violation(self):
+        # The table's handler column is checked against the COMPUTED
+        # register_handler/intercept sites, both directions.
+        lint = next(p for p in build_passes(REPO_ROOT)
+                    if p.name == "msg-flow")
+        lint.flow = dict(lint.flow)
+        kind, paired, _handlers, line = lint.flow["Control_Heartbeat"]
+        lint.flow["Control_Heartbeat"] = (kind, paired, ("shm",), line)
+        messages = [v.message for v in lint._doc_checks()]
+        assert any("Control_Heartbeat" in m
+                   and "declares handlers [shm]" in m
+                   and "computes [controller]" in m
+                   for m in messages)
 
     def test_doc_metric_table_matches_registry(self):
         from tools.mvlint.metric_lint import (load_metric_names,

@@ -122,6 +122,90 @@ class TestPopBatch:
         q.pop_batch(6)
         assert samples(name).snapshot()["count"] - before == 6
 
+    # -- racing interleavings (real threads; the systematic-schedule
+    #    twin of each lives in tools/mvchk specs) ---------------------
+
+    def test_producer_races_greedy_drain_at_byte_cap(self):
+        # A producer streams sized items while the consumer drains in
+        # byte-capped batches. Whatever the interleaving: nothing is
+        # lost or duplicated, concatenated batches are the push order
+        # (single producer => global FIFO), and every batch TAIL
+        # respects the cap (the first item is the one-message
+        # fallback and may exceed it).
+        sizes = [7, 120, 3, 40, 40, 40, 9, 200, 1, 1, 1, 55] * 25
+        cap = 100
+        q = MtQueue()
+        batches = []
+
+        def consume():
+            taken = 0
+            while taken < len(sizes):
+                batch = q.pop_batch(8, max_bytes=cap,
+                                    size_of=lambda v: v, timeout=5.0)
+                assert batch, "drain starved with items outstanding"
+                batches.append(batch)
+                taken += len(batch)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        for v in sizes:
+            q.push(v)
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        flat = [v for b in batches for v in b]
+        assert flat == sizes
+        for batch in batches:
+            assert sum(batch[1:]) <= cap - batch[0] or len(batch) == 1
+
+    def test_exit_races_block_for_first(self):
+        # stop() (queue exit) racing the block-for-first wait: the
+        # parked pop_batch must always wake and return [] — a lost
+        # exit wakeup here is exactly the mvchk `mtqueue-exit-wakes`
+        # deadlock, reproduced on real threads across many races.
+        for _ in range(50):
+            q = MtQueue()
+            got = []
+            started = threading.Event()
+
+            def consume():
+                started.set()
+                got.append(q.pop_batch(4, timeout=5.0))
+
+            t = threading.Thread(target=consume)
+            t.start()
+            started.wait(timeout=5.0)
+            q.exit()
+            t.join(timeout=5.0)
+            assert not t.is_alive(), "pop_batch missed the exit wakeup"
+            assert got == [[]]
+
+    def test_exit_races_drain_never_hides_items(self):
+        # Exit-drain ordering under a live race: everything pushed
+        # BEFORE exit() must come out of post-exit drains, in order,
+        # before the terminal [] — exit is a close, not a discard.
+        for _ in range(25):
+            q = MtQueue()
+            items = list(range(40))
+            recovered = []
+
+            def consume():
+                while True:
+                    batch = q.pop_batch(7)
+                    if not batch:
+                        return
+                    recovered.extend(batch)
+
+            t = threading.Thread(target=consume)
+            t.start()
+            for v in items:
+                q.push(v)
+            q.exit()
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+            # The consumer may legitimately observe [] the instant
+            # exit lands only AFTER the buffer is empty.
+            assert recovered == items
+
 
 # ---------------------------------------------------------------------------
 # server-level: stub zoo/tables driving the real dispatch machinery

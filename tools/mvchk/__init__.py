@@ -1,0 +1,22 @@
+"""mvchk: deterministic-schedule model checking for the concurrency
+core (the dynamic half of the PR-20 gate; mvlint is the static half).
+
+``python -m tools.mvchk`` runs every spec through systematic
+bounded-preemption exploration: real ``MtQueue``/``Waiter`` instances
+on model locks (via ``lock_witness.install_thread_model``), plus
+hand-built models of the event-loop wake protocol and dispatch
+backpressure. The pre-PR-19 wake-drain ordering ships as a known-bad
+spec the explorer must REFUTE — CI fails if the counterexample stops
+reproducing, the same self-check discipline as the mvlint fixtures.
+
+``--random N --seed S`` adds seeded-random long runs (the slow-CI
+soak). ``--spec NAME`` selects one spec; ``--list`` enumerates them.
+Docs: docs/STATIC_ANALYSIS.md ("The dynamic half: mvchk").
+"""
+
+from __future__ import annotations
+
+from .core import (Deadlock, ExploreResult, MaxStepsExceeded,
+                   ModelFacade, RunOutcome, Scheduler, Spec, explore,
+                   format_trace, run_once, soak)
+from .specs import ALL_SPECS, SPECS_BY_NAME

@@ -1,0 +1,104 @@
+"""CLI: ``python -m tools.mvchk [--spec NAME] [--random N] [--seed S]``.
+
+Exit status: 0 — every spec met its expectation (normal specs pass
+all explored schedules, ``expect_fail`` specs are refuted with a
+counterexample); 1 — a normal spec failed OR a known-bad spec was NOT
+refuted (the self-check: a checker that blesses the pre-PR-19
+ordering is broken and must fail CI); 2 — usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import explore, format_trace, soak
+from .specs import ALL_SPECS, SPECS_BY_NAME
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.mvchk",
+        description="deterministic-schedule model checker for the "
+                    "multiverso_tpu concurrency core")
+    parser.add_argument("--spec", action="append", default=None,
+                        help="run only this spec (repeatable)")
+    parser.add_argument("--list", action="store_true",
+                        help="list specs and exit")
+    parser.add_argument("--random", type=int, default=0, metavar="N",
+                        help="additionally run N seeded-random "
+                             "schedules per spec")
+    parser.add_argument("--seed", type=int, default=20260807,
+                        help="base seed for --random")
+    parser.add_argument("--max-schedules", type=int, default=600,
+                        help="systematic exploration budget per spec")
+    parser.add_argument("--preemption-bound", type=int, default=3)
+    parser.add_argument("--trace", action="store_true",
+                        help="print the full counterexample trace "
+                             "even for expected refutations")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for spec in ALL_SPECS:
+            tag = "  [known-bad]" if spec.expect_fail else ""
+            print(f"{spec.name:<24} {spec.describe}{tag}")
+        return 0
+
+    if args.spec:
+        unknown = [n for n in args.spec if n not in SPECS_BY_NAME]
+        if unknown:
+            print(f"mvchk: unknown spec(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        specs = [SPECS_BY_NAME[n] for n in args.spec]
+    else:
+        specs = ALL_SPECS
+
+    failures = 0
+    for spec in specs:
+        result = explore(spec, preemption_bound=args.preemption_bound,
+                         max_schedules=args.max_schedules)
+        verdict = None
+        if spec.expect_fail:
+            if result.refuted:
+                verdict = (f"refuted as required "
+                           f"({result.schedules} schedules)")
+            else:
+                verdict = (f"NOT refuted in {result.schedules} "
+                           f"schedules — the checker lost the "
+                           f"known-bad counterexample")
+                failures += 1
+        else:
+            if result.refuted:
+                verdict = (f"FAILED at schedule {result.schedules}")
+                failures += 1
+            else:
+                verdict = f"ok ({result.schedules} schedules)"
+            if not result.refuted and args.random > 0:
+                s = soak(spec, runs=args.random, seed=args.seed)
+                if s.refuted:
+                    verdict = (f"FAILED on random run "
+                               f"{s.schedules} (seed base "
+                               f"{args.seed})")
+                    result = s
+                    failures += 1
+                else:
+                    verdict += f" + {args.random} random runs"
+        print(f"mvchk: {spec.name:<24} {verdict}")
+        if result.counterexample is not None and (
+                args.trace or not spec.expect_fail or
+                (spec.expect_fail and not result.refuted)):
+            print(format_trace(result.counterexample))
+        elif result.counterexample is not None and spec.expect_fail:
+            # Always show the refutation's last steps: the readable
+            # interleaving is the point of the self-check.
+            print(format_trace(result.counterexample, limit=24))
+    if failures:
+        print(f"mvchk: FAILED ({failures} spec(s))")
+        return 1
+    print(f"mvchk: OK ({len(specs)} specs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,526 @@
+"""mvchk core: a deterministic cooperative scheduler for model-checking
+the runtime's concurrency primitives.
+
+The design is the classic baton scheduler: every logical thread of a
+spec runs on a real OS thread, but exactly ONE is ever runnable — each
+shared-memory operation funnels through :meth:`Scheduler.yield_point`,
+which parks the task on a per-task event and hands the baton back to
+the scheduler, so the scheduler alone decides the global interleaving.
+A program under test is therefore a *deterministic function of the
+schedule* (the choice sequence), which is what makes systematic replay,
+bounded-preemption enumeration, and counterexample reproduction
+possible at all.
+
+Blocking is a predicate, not a park: a blocked task publishes
+``pred()`` and the scheduler re-evaluates it each step (nothing else
+runs concurrently, so evaluation is race-free). Deadlock is then a
+*decided* property — no task runnable, none timed, some unfinished —
+and the trace up to that point IS the counterexample. Timeouts use
+virtual time: a timed wait expires only when nothing else is runnable
+(the scheduler advances ``vtime`` and delivers ``timed_out``), which
+matches the primitives' deadline loops without patching ``time``.
+
+:class:`ModelFacade` adapts the scheduler to the
+``lock_witness.install_thread_model`` hook, so the REAL ``MtQueue``
+and ``Waiter`` run unmodified on model locks/conditions with the
+virtual clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import (Callable, Dict, List, Optional, Sequence, Tuple)
+
+DEFAULT_MAX_STEPS = 4000
+
+
+class Deadlock(Exception):
+    """No runnable task, no timed wait, unfinished tasks remain."""
+
+    def __init__(self, blocked: List[Tuple[str, str]]):
+        self.blocked = blocked
+        detail = "; ".join(f"{name} blocked at {label}"
+                           for name, label in blocked)
+        super().__init__(f"deadlock: {detail}")
+
+
+class MaxStepsExceeded(Exception):
+    pass
+
+
+class _Killed(BaseException):
+    """Unwinds a task thread when a run is torn down early (deadlock,
+    failed invariant); BaseException so spec code cannot catch it."""
+
+
+_current = threading.local()
+
+
+class _Task:
+    __slots__ = ("tid", "name", "fn", "thread", "go", "done", "exc",
+                 "label", "pred", "timeout_ok", "timed_out", "killed")
+
+    def __init__(self, tid: int, name: str, fn: Callable[[], None]):
+        self.tid = tid
+        self.name = name
+        self.fn = fn
+        self.thread: Optional[threading.Thread] = None
+        self.go = threading.Event()
+        self.done = False
+        self.exc: Optional[BaseException] = None
+        self.label = "start"
+        self.pred: Optional[Callable[[], bool]] = None
+        self.timeout_ok = False
+        self.timed_out = False
+        self.killed = False
+
+
+@dataclasses.dataclass
+class Choice:
+    """One scheduling decision (the explorer branches on these)."""
+    runnable: Tuple[int, ...]
+    chosen: int
+    prev: Optional[int]
+    preempt: bool     # prev was still runnable but a different task ran
+
+
+class Scheduler:
+    """One deterministic run. ``choose(step, runnable_tids, prev_tid)``
+    picks the next task id each step."""
+
+    def __init__(self, choose: Callable[[int, Sequence[int],
+                                         Optional[int]], int],
+                 max_steps: int = DEFAULT_MAX_STEPS):
+        self._choose = choose
+        self.max_steps = max_steps
+        self.tasks: List[_Task] = []
+        self.vtime = 0.0
+        self.trace: List[Tuple[str, str]] = []
+        self.choices: List[Choice] = []
+        self._resume = threading.Event()
+
+    # -- spec-facing -------------------------------------------------
+    def spawn(self, name: str, fn: Callable[[], None]) -> None:
+        self.tasks.append(_Task(len(self.tasks), name, fn))
+
+    def yield_point(self, label: str,
+                    pred: Optional[Callable[[], bool]] = None,
+                    timeout_ok: bool = False) -> bool:
+        """Hand the baton back; resume when scheduled. Returns True
+        iff the wait expired via virtual time instead of ``pred``."""
+        task: _Task = _current.task
+        task.label = label
+        task.pred = pred
+        task.timeout_ok = bool(timeout_ok and pred is not None)
+        task.timed_out = False
+        task.go.clear()
+        self._resume.set()
+        task.go.wait()
+        if task.killed:
+            raise _Killed()
+        return task.timed_out
+
+    def wait_until(self, label: str, pred: Callable[[], bool],
+                   timeout_ok: bool = False) -> bool:
+        """Block until ``pred`` holds (or virtual-time expiry when
+        ``timeout_ok``). Returns True iff it timed out."""
+        return self.yield_point(label, pred=pred, timeout_ok=timeout_ok)
+
+    def current_task(self) -> _Task:
+        return _current.task
+
+    # -- the run loop ------------------------------------------------
+    def _task_main(self, task: _Task) -> None:
+        _current.task = task
+        task.go.wait()
+        try:
+            if not task.killed:
+                task.fn()
+        except _Killed:
+            pass
+        except BaseException as exc:  # invariant failures included
+            task.exc = exc
+        finally:
+            task.done = True
+            self._resume.set()
+
+    def run(self) -> None:
+        for task in self.tasks:
+            task.thread = threading.Thread(
+                target=self._task_main, args=(task,),
+                name=f"mvchk-{task.name}", daemon=True)
+            task.thread.start()
+        prev: Optional[int] = None
+        step = 0
+        while True:
+            unfinished = [t for t in self.tasks if not t.done]
+            failed = [t for t in self.tasks if t.exc is not None]
+            if failed:
+                raise failed[0].exc
+            if not unfinished:
+                return
+            runnable = [t for t in unfinished
+                        if t.pred is None or t.pred()]
+            timed_out = False
+            if not runnable:
+                timed = [t for t in unfinished if t.timeout_ok]
+                if not timed:
+                    raise Deadlock([(t.name, t.label)
+                                    for t in unfinished])
+                self.vtime += 1.0
+                runnable, timed_out = timed, True
+            tids = tuple(t.tid for t in runnable)
+            chosen_tid = self._choose(step, tids, prev)
+            if chosen_tid not in tids:
+                chosen_tid = tids[0]
+            chosen = self.tasks[chosen_tid]
+            self.choices.append(Choice(
+                tids, chosen_tid, prev,
+                preempt=(prev is not None and prev in tids
+                         and chosen_tid != prev
+                         and not self.tasks[prev].done)))
+            self.trace.append((chosen.name, chosen.label))
+            step += 1
+            if step > self.max_steps:
+                raise MaxStepsExceeded(
+                    f"{step} scheduling steps (possible livelock)")
+            chosen.timed_out = timed_out
+            chosen.pred = None
+            chosen.timeout_ok = False
+            self._resume.clear()
+            chosen.go.set()
+            self._resume.wait()
+            prev = chosen_tid
+
+    def shutdown(self) -> None:
+        """Tear down parked task threads after an aborted run."""
+        for task in self.tasks:
+            if not task.done:
+                task.killed = True
+                task.go.set()
+        for task in self.tasks:
+            if task.thread is not None:
+                task.thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------
+# model primitives (threading-compatible surface)
+# ---------------------------------------------------------------------
+
+class MLock:
+    """Model lock: reentrant-capable, one schedule point per op."""
+
+    def __init__(self, sched: Scheduler, name: str):
+        self._sched = sched
+        self._name = name
+        self._holder: Optional[_Task] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = self._sched.current_task()
+        if self._holder is me:
+            self._count += 1
+            return True
+        timeout_ok = timeout is not None and timeout >= 0
+        expired = self._sched.wait_until(
+            f"acquire({self._name})",
+            lambda: self._holder is None, timeout_ok=timeout_ok)
+        if expired:
+            return False
+        self._holder = me
+        self._count = 1
+        return True
+
+    def release(self) -> None:
+        me = self._sched.current_task()
+        if self._holder is not me:
+            raise RuntimeError(f"release of unheld lock {self._name}")
+        self._sched.yield_point(f"release({self._name})")
+        self._count -= 1
+        if self._count == 0:
+            self._holder = None
+
+    def locked(self) -> bool:
+        return self._holder is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class MCondition:
+    """Model condition over an :class:`MLock`. No spurious wakeups:
+    a waiter returns exactly when notified or virtually timed out —
+    lost-wakeup bugs surface as deadlocks, not flaky sleeps."""
+
+    def __init__(self, sched: Scheduler, name: str, lock: MLock):
+        self._sched = sched
+        self._name = name
+        self._lock = lock
+        self._waiters: List[List[bool]] = []
+
+    # lock surface (``with cond:`` parity with threading.Condition)
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched = self._sched
+        me = sched.current_task()
+        if self._lock._holder is not me:
+            raise RuntimeError(f"wait on {self._name} without lock")
+        token = [False]
+        self._waiters.append(token)
+        # Release-and-enqueue is atomic (no schedule point), like the
+        # real Condition; the reacquire below is a contended point.
+        held, self._lock._count = self._lock._count, 0
+        self._lock._holder = None
+        expired = sched.yield_point(
+            f"wait({self._name})", pred=lambda: token[0],
+            timeout_ok=timeout is not None)
+        if expired and token in self._waiters:
+            self._waiters.remove(token)
+        sched.wait_until(f"reacquire({self._name})",
+                         lambda: self._lock._holder is None)
+        self._lock._holder = me
+        self._lock._count = held
+        return token[0]
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        result = predicate()
+        while not result:
+            if not self.wait(timeout):
+                return predicate()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._sched.yield_point(f"notify({self._name})")
+        for _ in range(min(n, len(self._waiters))):
+            self._waiters.pop(0)[0] = True
+
+    def notify_all(self) -> None:
+        self._sched.yield_point(f"notify_all({self._name})")
+        while self._waiters:
+            self._waiters.pop(0)[0] = True
+
+
+class ModelFacade:
+    """The object handed to ``lock_witness.install_thread_model``."""
+
+    def __init__(self, sched: Scheduler):
+        self._sched = sched
+
+    def lock(self, name: str) -> MLock:
+        return MLock(self._sched, name)
+
+    def rlock(self, name: str) -> MLock:
+        return MLock(self._sched, name)
+
+    def condition(self, name: str, lock=None) -> MCondition:
+        if lock is None:
+            lock = MLock(self._sched, f"{name}.mutex")
+        return MCondition(self._sched, name, lock)
+
+    def monotonic(self) -> float:
+        return self._sched.vtime
+
+
+# ---------------------------------------------------------------------
+# shared-state helpers for hand-built protocol models (specs.py)
+# ---------------------------------------------------------------------
+
+class SchedVar:
+    """A shared scalar where every read/write is a schedule point —
+    the granularity at which real threads race on an attribute."""
+
+    def __init__(self, sched: Scheduler, name: str, value):
+        self._sched = sched
+        self._name = name
+        self.value = value
+
+    def read(self):
+        self._sched.yield_point(f"read {self._name}")
+        return self.value
+
+    def write(self, value) -> None:
+        self._sched.yield_point(f"{self._name} := {value!r}")
+        self.value = value
+
+
+class SchedPipe:
+    """The self-pipe: byte-counting, with a parking ``select``."""
+
+    def __init__(self, sched: Scheduler, name: str = "pipe"):
+        self._sched = sched
+        self._name = name
+        self.bytes = 0
+
+    def write_byte(self) -> None:
+        self._sched.yield_point(f"write byte -> {self._name}")
+        self.bytes += 1
+
+    def select(self) -> None:
+        self._sched.wait_until(f"select({self._name})",
+                               lambda: self.bytes > 0)
+
+    def drain(self) -> None:
+        self._sched.yield_point(f"drain {self._name}")
+        self.bytes = 0
+
+
+# ---------------------------------------------------------------------
+# running specs: single runs, systematic exploration, random soak
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Spec:
+    """One model-checking scenario. ``setup(sched)`` spawns the tasks
+    and returns an optional end-of-run invariant check. When
+    ``uses_model`` is set, the run installs a :class:`ModelFacade`
+    into ``lock_witness`` around setup+run so real primitives build
+    model locks. ``expect_fail`` marks known-bad models the explorer
+    must REFUTE (the CI self-check)."""
+    name: str
+    describe: str
+    setup: Callable[[Scheduler], Optional[Callable[[], None]]]
+    uses_model: bool = False
+    expect_fail: bool = False
+
+
+@dataclasses.dataclass
+class RunOutcome:
+    ok: bool
+    error: Optional[BaseException]
+    trace: List[Tuple[str, str]]
+    schedule: List[int]
+    choices: List[Choice]
+
+
+def run_once(spec: Spec, prefix: Sequence[int] = (),
+             seed: Optional[int] = None,
+             max_steps: int = DEFAULT_MAX_STEPS) -> RunOutcome:
+    """One deterministic run: replay ``prefix``, then continue with
+    the default strategy (stay on the current task, else lowest tid)
+    or — when ``seed`` is given — uniform random choices."""
+    rng = random.Random(seed) if seed is not None else None
+
+    def choose(step: int, runnable: Sequence[int],
+               prev: Optional[int]) -> int:
+        if step < len(prefix) and prefix[step] in runnable:
+            return prefix[step]
+        if step >= len(prefix) and rng is not None:
+            return rng.choice(list(runnable))
+        if prev is not None and prev in runnable:
+            return prev
+        return runnable[0]
+
+    sched = Scheduler(choose, max_steps=max_steps)
+    installed = False
+    error: Optional[BaseException] = None
+    check: Optional[Callable[[], None]] = None
+    try:
+        if spec.uses_model:
+            from multiverso_tpu.util import lock_witness
+            lock_witness.install_thread_model(ModelFacade(sched))
+            installed = True
+        check = spec.setup(sched)
+        sched.run()
+        if check is not None:
+            check()
+    except (Deadlock, MaxStepsExceeded, AssertionError) as exc:
+        error = exc
+    except _Killed:  # pragma: no cover - never escapes tasks
+        raise
+    except Exception as exc:
+        error = exc
+    finally:
+        sched.shutdown()
+        if installed:
+            from multiverso_tpu.util import lock_witness
+            lock_witness.clear_thread_model()
+    return RunOutcome(ok=error is None, error=error,
+                      trace=list(sched.trace),
+                      schedule=[c.chosen for c in sched.choices],
+                      choices=list(sched.choices))
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    refuted: bool
+    counterexample: Optional[RunOutcome]
+    schedules: int
+
+
+def explore(spec: Spec, preemption_bound: int = 3,
+            max_schedules: int = 400,
+            max_steps: int = DEFAULT_MAX_STEPS) -> ExploreResult:
+    """Iterative-context-bounding exploration: depth-first over
+    schedule prefixes, branching to every runnable alternative at
+    every step, pruned by the number of *preemptions* (switching away
+    from a still-runnable task) a prefix spends. Bound 2-3 covers the
+    classic lost-wakeup/TOCTOU interleavings at a tiny fraction of
+    the full factorial space."""
+    stack: List[Tuple[int, ...]] = [()]
+    explored = 0
+    while stack and explored < max_schedules:
+        prefix = stack.pop()
+        out = run_once(spec, prefix=prefix, max_steps=max_steps)
+        explored += 1
+        if not out.ok:
+            return ExploreResult(True, out, explored)
+        preempts = 0
+        for i, choice in enumerate(out.choices):
+            if i >= len(prefix):
+                for alt in choice.runnable:
+                    if alt == choice.chosen:
+                        continue
+                    cost = preempts + (
+                        1 if choice.prev is not None
+                        and choice.prev in choice.runnable
+                        and alt != choice.prev else 0)
+                    if cost <= preemption_bound:
+                        stack.append(tuple(out.schedule[:i]) + (alt,))
+            if choice.preempt:
+                preempts += 1
+    return ExploreResult(False, None, explored)
+
+
+def soak(spec: Spec, runs: int, seed: int,
+         max_steps: int = DEFAULT_MAX_STEPS) -> ExploreResult:
+    """Seeded-random long runs: same determinism guarantee (a failing
+    seed replays exactly), wider reach than the bounded frontier."""
+    for i in range(runs):
+        out = run_once(spec, seed=seed + i, max_steps=max_steps)
+        if not out.ok:
+            return ExploreResult(True, out, i + 1)
+    return ExploreResult(False, None, runs)
+
+
+def format_trace(out: RunOutcome, limit: int = 80) -> str:
+    lines = []
+    tail = out.trace[-limit:]
+    if len(out.trace) > limit:
+        lines.append(f"  ... {len(out.trace) - limit} earlier steps")
+    for i, (name, label) in enumerate(tail,
+                                      len(out.trace) - len(tail) + 1):
+        lines.append(f"  step {i:3d}  {name:<14} {label}")
+    if out.error is not None:
+        lines.append(f"  => {type(out.error).__name__}: {out.error}")
+    return "\n".join(lines)

@@ -1,0 +1,428 @@
+"""mvchk invariant specs: the concurrency core under controlled
+interleavings.
+
+Two families:
+
+* **Real-primitive specs** (``uses_model=True``) — the actual
+  ``MtQueue`` / ``Waiter`` implementations run unmodified on model
+  locks/conditions via the ``lock_witness.install_thread_model`` hook:
+  FIFO, no lost wakeup on push/exit, ``pop_batch`` byte-cap and
+  exit-drain semantics, timeout expiry through the virtual clock,
+  ``_VectorClock`` monotonicity (strict BSP and backup-worker cutoff).
+* **Protocol models** — hand-built replicas of runtime protocols too
+  entangled with sockets to lift whole: the event-loop wake latch +
+  self-pipe (``runtime/tcp.py _EventLoop``) in its current
+  re-arm-first ordering AND the pre-PR-19 check-then-re-arm ordering.
+  The latter is the known-bad fixture: ``expect_fail=True`` makes the
+  explorer's job *refutation* — CI fails if mvchk ever stops finding
+  the lost-wakeup deadlock (the analyzer self-check, mvlint-fixture
+  style).
+
+Every spec terminates in every legal schedule; a deadlock IS the bug.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .core import (MCondition, MLock, SchedPipe, SchedVar, Scheduler,
+                   Spec)
+
+# Imported at module scope ON PURPOSE: any module-level primitive
+# construction in the transitive imports must happen while NO thread
+# model is installed, or model locks would leak into real runtime
+# state that outlives the run.
+from multiverso_tpu.runtime.server import _VectorClock
+from multiverso_tpu.util.mt_queue import MtQueue
+from multiverso_tpu.util.waiter import Waiter
+
+
+# ---------------------------------------------------------------------
+# MtQueue under the model (the real class, model primitives)
+# ---------------------------------------------------------------------
+
+def _mtqueue_fifo(sched: Scheduler):
+    q: MtQueue = MtQueue("chk.fifo")
+    got: List[int] = []
+
+    def producer_a():
+        q.push(1)
+        q.push(2)
+
+    def producer_b():
+        q.push(10)
+        q.push(11)
+
+    def consumer():
+        for _ in range(4):
+            item = q.pop()
+            assert item is not None, "pop returned None before exit"
+            got.append(item)
+
+    sched.spawn("producer-a", producer_a)
+    sched.spawn("producer-b", producer_b)
+    sched.spawn("consumer", consumer)
+
+    def check():
+        assert sorted(got) == [1, 2, 10, 11], got
+        assert got.index(1) < got.index(2), f"per-producer order: {got}"
+        assert got.index(10) < got.index(11), \
+            f"per-producer order: {got}"
+    return check
+
+
+def _mtqueue_pop_timeout(sched: Scheduler):
+    q: MtQueue = MtQueue("chk.timeout")
+    out: List[object] = []
+
+    def consumer():
+        out.append(q.pop(timeout=1.0))
+        out.append(q.pop_batch(timeout=1.0))
+
+    sched.spawn("consumer", consumer)
+
+    def check():
+        assert out == [None, []], \
+            f"timed pop on an empty queue must expire empty: {out}"
+    return check
+
+
+def _mtqueue_pop_batch_cap(sched: Scheduler):
+    q: MtQueue = MtQueue("chk.batchcap")
+    pushed = [10, 60, 50, 5]
+    state: dict = {}
+
+    def producer():
+        for v in pushed:
+            q.push(v)
+
+    def consumer():
+        batch = q.pop_batch(max_items=8, max_bytes=100,
+                            size_of=lambda v: v)
+        state["batch"] = batch
+
+    sched.spawn("producer", producer)
+    sched.spawn("consumer", consumer)
+
+    def check():
+        batch = state["batch"]
+        assert batch, "block-for-first must return at least one item"
+        assert batch == pushed[:len(batch)], \
+            f"batch must be a FIFO prefix of the pushes: {batch}"
+        if len(batch) > 1:
+            assert sum(batch[1:]) <= 100 - batch[0], \
+                f"byte cap must bound the batch tail: {batch}"
+    return check
+
+
+def _mtqueue_exit_drain(sched: Scheduler):
+    """exit() must never hide an item already queued: the first drain
+    after exit returns the item, the next returns []."""
+    q: MtQueue = MtQueue("chk.exitdrain")
+    state: dict = {}
+
+    def producer():
+        q.push("a")
+        q.exit()
+
+    def consumer():
+        state["b1"] = q.pop_batch()
+        state["b2"] = q.pop_batch()
+
+    sched.spawn("producer", producer)
+    sched.spawn("consumer", consumer)
+
+    def check():
+        assert state["b1"] == ["a"], \
+            f"exit hid a queued item: {state}"
+        assert state["b2"] == [], f"post-drain must be []: {state}"
+    return check
+
+
+def _mtqueue_exit_wakes(sched: Scheduler):
+    """stop() racing block-for-first: exit with nothing queued must
+    wake the blocked pop_batch (a lost exit-notify is a deadlock the
+    scheduler detects)."""
+    q: MtQueue = MtQueue("chk.exitwake")
+    state: dict = {}
+
+    def consumer():
+        state["batch"] = q.pop_batch()
+
+    def stopper():
+        q.exit()
+
+    sched.spawn("consumer", consumer)
+    sched.spawn("stopper", stopper)
+
+    def check():
+        assert state["batch"] == [], state
+    return check
+
+
+# ---------------------------------------------------------------------
+# Waiter under the model
+# ---------------------------------------------------------------------
+
+def _waiter_countdown(sched: Scheduler):
+    w = Waiter(2, name="chk.countdown")
+    state: dict = {}
+
+    def notifier():
+        w.notify()
+
+    def waiter_task():
+        state["ok"] = w.wait()
+
+    sched.spawn("notifier-1", notifier)
+    sched.spawn("notifier-2", notifier)
+    sched.spawn("waiter", waiter_task)
+
+    def check():
+        assert state["ok"] is True, "waiter missed a notify"
+    return check
+
+
+def _waiter_add_waits_race(sched: Scheduler):
+    """The replica-repair extension racing completion: whatever the
+    order, the waiter must complete (a completed waiter drops the
+    extension; an outstanding one absorbs it)."""
+    w = Waiter(1, name="chk.addwaits")
+    state: dict = {}
+
+    def completer():
+        w.notify()
+
+    def repairer():
+        w.add_waits(1)
+        w.notify()
+
+    def waiter_task():
+        state["ok"] = w.wait()
+
+    sched.spawn("completer", completer)
+    sched.spawn("repairer", repairer)
+    sched.spawn("waiter", waiter_task)
+
+    def check():
+        assert state["ok"] is True, "add_waits stranded the waiter"
+    return check
+
+
+def _waiter_release_race(sched: Scheduler):
+    w = Waiter(2, name="chk.release")
+    state: dict = {}
+
+    def notifier():
+        w.notify()
+
+    def aborter():
+        w.release()
+
+    def waiter_task():
+        state["ok"] = w.wait()
+
+    sched.spawn("notifier", notifier)
+    sched.spawn("aborter", aborter)
+    sched.spawn("waiter", waiter_task)
+
+    def check():
+        assert state["ok"] is True, "release must force-complete"
+    return check
+
+
+# ---------------------------------------------------------------------
+# _VectorClock (actor-confined: ops serialized under a model lock)
+# ---------------------------------------------------------------------
+
+def _vector_clock(sched: Scheduler, n: int, num_backup: int,
+                  ticks: int, expect_final: float):
+    clock = _VectorClock(n, num_backup)
+    lock = MLock(sched, "clock")
+    observed: List[float] = []
+    trues: List[float] = []
+
+    def worker(i: int):
+        def body():
+            for _ in range(ticks):
+                with lock:
+                    level = clock.update(i)
+                    observed.append(clock.global_clock)
+                    if level:
+                        trues.append(clock.global_clock)
+            with lock:
+                clock.finish_train(i)
+                observed.append(clock.global_clock)
+        return body
+
+    for i in range(n):
+        sched.spawn(f"worker-{i}", worker(i))
+
+    def check():
+        for a, b in zip(observed, observed[1:]):
+            assert a <= b, f"global clock regressed: {observed}"
+        finite = [v for v in observed if v != float("inf")]
+        assert finite and max(finite) == expect_final, \
+            f"global must reach {expect_final}: {observed}"
+        assert trues, "no update ever reported the workers level"
+    return check
+
+
+def _vector_clock_strict(sched: Scheduler):
+    return _vector_clock(sched, n=2, num_backup=0, ticks=2,
+                         expect_final=2.0)
+
+
+def _vector_clock_backup(sched: Scheduler):
+    return _vector_clock(sched, n=3, num_backup=1, ticks=1,
+                         expect_final=1.0)
+
+
+# ---------------------------------------------------------------------
+# dispatch backpressure (bounded submit, the tcp peer-queue shape)
+# ---------------------------------------------------------------------
+
+def _dispatch_backpressure(sched: Scheduler):
+    lock = MLock(sched, "bp")
+    cond = MCondition(sched, "bp.cond", lock)
+    state = {"q": [], "used": 0, "drained": []}
+    cap, total = 2, 4
+
+    def producer():
+        for i in range(total):
+            with cond:
+                while state["used"] >= cap:
+                    cond.wait()
+                state["q"].append(i)
+                state["used"] += 1
+                cond.notify_all()
+
+    def drainer():
+        while len(state["drained"]) < total:
+            with cond:
+                while not state["q"]:
+                    cond.wait()
+                state["drained"].append(state["q"].pop(0))
+                state["used"] -= 1
+                cond.notify_all()
+
+    sched.spawn("producer", producer)
+    sched.spawn("drainer", drainer)
+
+    def check():
+        assert state["drained"] == list(range(total)), state
+        assert state["used"] == 0, state
+    return check
+
+
+# ---------------------------------------------------------------------
+# the event-loop wake latch + self-pipe (runtime/tcp.py _EventLoop)
+# ---------------------------------------------------------------------
+
+def _event_loop(sched: Scheduler, pre_pr19: bool):
+    """The latch/pipe/stop protocol of ``_EventLoop``:
+
+    * ``wake()`` is the real gate: test latch, set latch, write byte.
+    * the loop models ``_main``: re-arm, stop-check, ``select``,
+      drain — with ``pre_pr19=True`` the re-arm happens AFTER the
+      stop-check (the shipped bug's ordering), which deadlocks when a
+      ``stop()`` lands in the drain-to-re-arm window and its ``wake``
+      sees the stale latch.
+    * the stopper models a ``call_soon`` nudge then ``stop()``
+      (stop = set stopped, wake) — exactly tcp.py's sequence.
+    """
+    woken = SchedVar(sched, "woken", False)
+    stopped = SchedVar(sched, "stopped", False)
+    pipe = SchedPipe(sched, "wakepipe")
+    iters = {"n": 0}
+
+    def wake():
+        if woken.read():
+            return
+        woken.write(True)
+        pipe.write_byte()
+
+    def loop():
+        while True:
+            iters["n"] += 1
+            assert iters["n"] <= 10, "event loop livelocked"
+            if pre_pr19:
+                if stopped.read():
+                    return
+                woken.write(False)   # re-arm AFTER the state check
+            else:
+                woken.write(False)   # re-arm FIRST (tcp.py:489)
+                if stopped.read():
+                    return
+            pipe.select()
+            pipe.drain()
+
+    def stopper():
+        wake()                       # the call_soon work nudge
+        stopped.write(True)          # stop(): flag, then wake
+        wake()
+
+    sched.spawn("loop", loop)
+    sched.spawn("stopper", stopper)
+    return None
+
+
+def _event_loop_good(sched: Scheduler):
+    return _event_loop(sched, pre_pr19=False)
+
+
+def _event_loop_pre_pr19(sched: Scheduler):
+    return _event_loop(sched, pre_pr19=True)
+
+
+# ---------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------
+
+ALL_SPECS: List[Spec] = [
+    Spec("mtqueue-fifo",
+         "two producers, one consumer: FIFO per producer, no lost "
+         "push wakeup", _mtqueue_fifo, uses_model=True),
+    Spec("mtqueue-pop-timeout",
+         "timed pop/pop_batch on an empty queue expires via the "
+         "virtual clock", _mtqueue_pop_timeout, uses_model=True),
+    Spec("mtqueue-pop-batch-cap",
+         "producer races the greedy drain at the byte cap: batch is "
+         "a FIFO prefix, tail bounded", _mtqueue_pop_batch_cap,
+         uses_model=True),
+    Spec("mtqueue-exit-drain",
+         "exit() racing a drain never hides a queued item",
+         _mtqueue_exit_drain, uses_model=True),
+    Spec("mtqueue-exit-wakes",
+         "exit() racing block-for-first always wakes the blocked "
+         "pop_batch", _mtqueue_exit_wakes, uses_model=True),
+    Spec("waiter-countdown",
+         "countdown latch: N notifies release the waiter in every "
+         "order", _waiter_countdown, uses_model=True),
+    Spec("waiter-add-waits-race",
+         "add_waits racing completion never strands the waiter",
+         _waiter_add_waits_race, uses_model=True),
+    Spec("waiter-release-race",
+         "release() force-completes against a concurrent notify",
+         _waiter_release_race, uses_model=True),
+    Spec("vector-clock-strict",
+         "_VectorClock strict BSP: global clock monotone, levels at "
+         "the common tick", _vector_clock_strict, uses_model=True),
+    Spec("vector-clock-backup",
+         "_VectorClock backup-worker cutoff: stragglers do not gate, "
+         "clock stays monotone", _vector_clock_backup,
+         uses_model=True),
+    Spec("dispatch-backpressure",
+         "bounded submit against a drainer: FIFO, full drain, no "
+         "lost capacity wakeup", _dispatch_backpressure,
+         uses_model=True),
+    Spec("event-loop-wake",
+         "current _EventLoop ordering (re-arm before checks): no "
+         "lost wakeup in any bounded schedule", _event_loop_good),
+    Spec("event-loop-pre-pr19",
+         "KNOWN-BAD: the pre-PR-19 check-then-re-arm ordering — the "
+         "explorer must refute it with a lost-wakeup deadlock",
+         _event_loop_pre_pr19, expect_fail=True),
+]
+
+SPECS_BY_NAME = {spec.name: spec for spec in ALL_SPECS}

@@ -1,6 +1,6 @@
 """mvlint: project-invariant static analysis for the actor/PS runtime.
 
-Ten passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
+Twelve passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
 (see each module's docstring for the precise rules):
 
 * ``flag-lint`` — every flag access names a canonical registered flag
@@ -36,6 +36,16 @@ Ten passes over ``multiverso_tpu/``, ``bench.py`` and ``tests/``
   touched under their witness-registered lock, lexically or via the
   caller-holds analysis (Clang ``-Wthread-safety`` adapted to
   ``lock_witness``).
+* ``msg-flow`` — the message-protocol graph (``register_handler``
+  dispatch, intercept-by-name, reply pairing) checked against the
+  flow table in ``docs/WIRE_FORMAT.md`` both directions: every
+  request type has a handler that answers, every worker-band reply
+  handler reaches its ``Waiter`` notify AND inspects ``take_error``,
+  no duplicate type ints, no dead types.
+* ``wake-protocol`` — the gated wake-latch idiom (self-pipe /
+  condition wake with a boolean gate) must re-arm the latch before
+  the state checks and the park, in the lexical order the event loop
+  uses post-PR-19 (the lost-wakeup ordering is rejected).
 
 Run locally: ``python -m tools.mvlint multiverso_tpu tests bench.py``
 (``--baseline`` prints per-pass counts without failing;
@@ -60,10 +70,12 @@ from .framework import LintPass, RunResult, Violation, run_passes
 from .guard_lint import GuardedByLint
 from .lock_lint import LockDisciplineLint
 from .metric_lint import MetricNameLint, load_metric_names
+from .msg_flow_lint import MsgFlowLint
 from .role_lint import ThreadRoleLint
 from .send_lint import SendDisciplineLint
 from .tunable_lint import (TunableLint, load_autotune_policies,
                            load_tunable_flags, scan_hook_sites)
+from .wake_lint import WakeProtocolLint
 from .wire_slot_lint import (WireSlotLint, load_msg_types,
                              load_wire_slots)
 
@@ -101,6 +113,8 @@ def build_passes(root: Path = REPO_ROOT) -> List[LintPass]:
         CopyLint(root / "docs" / "MEMORY.md"),
         ThreadRoleLint(root, graph),
         GuardedByLint(graph),
+        MsgFlowLint(root, graph),
+        WakeProtocolLint(),
     ]
 
 

@@ -1,0 +1,126 @@
+"""msg-flow fixture: seeded protocol-graph violations (never imported).
+
+Expected findings (tests/test_mvlint.py pins the counts):
+  line A: duplicate register_handler for one type in one
+          class (dispatch dict keeps only the last)      -> violation
+  line B: worker-band reply handler that checks
+          take_error but never reaches Waiter.notify     -> violation
+  line C: worker-band reply handler that notifies but
+          never inspects take_error (mark_error replies
+          vanish instead of raising)                     -> violation
+  line D: request handler that never constructs the
+          paired reply (nobody answers)                  -> violation
+  line E: pragma'd duplicate registration               -> suppressed
+Clean: EchoServer answers its request through
+create_reply_message, notify+take_error both present in
+FullReplies.
+"""
+
+from multiverso_tpu.core.message import MsgType, create_reply_message
+
+
+class DoubleRegister:
+    def __init__(self):
+        self.register_handler(MsgType.Control_Metrics, self._on_a)
+        self.register_handler(MsgType.Control_Metrics, self._on_b)  # A
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_a(self, msg):
+        pass
+
+    def _on_b(self, msg):
+        pass
+
+
+class NoNotifyReplies:
+    """Reply_Get handler loses the waiter: the requester's
+    Waiter.wait() blocks forever even though the reply arrived."""
+
+    def __init__(self, waiter):
+        self._waiter = waiter
+        self.register_handler(MsgType.Reply_Get, self._on_reply_get)
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_reply_get(self, msg):                                   # B
+        err = msg.take_error()
+        if err is not None:
+            raise RuntimeError(err)
+
+
+class NoErrorReplies:
+    """Reply_Add handler counts the waiter down but never looks at
+    take_error: a mark_error reply reads as success."""
+
+    def __init__(self, waiter):
+        self._waiter = waiter
+        self.register_handler(MsgType.Reply_Add, self._on_reply_add)
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_reply_add(self, msg):                                   # C
+        self._waiter.notify()
+
+
+class DeafServer:
+    """Request_Get is a request (the flow table pairs it with
+    Reply_Get) but this handler never answers."""
+
+    def __init__(self):
+        self.register_handler(MsgType.Request_Get, self._on_get)
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_get(self, msg):                                         # D
+        self.rows = msg.blob(0)
+
+
+class PragmaDouble:
+    def __init__(self):
+        self.register_handler(MsgType.Control_Barrier, self._on_a)
+        self.register_handler(  # mvlint: ignore[msg-flow]  (E)
+            MsgType.Control_Barrier, self._on_b)
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_a(self, msg):
+        return create_reply_message(msg)
+
+    def _on_b(self, msg):
+        return create_reply_message(msg)
+
+
+class EchoServer:
+    """Clean: the request handler constructs the paired reply."""
+
+    def __init__(self):
+        self.register_handler(MsgType.Request_Add, self._on_add)
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_add(self, msg):
+        return create_reply_message(msg)
+
+
+class FullReplies:
+    """Clean: notify AND take_error on the worker-band reply path."""
+
+    def __init__(self, waiter):
+        self._waiter = waiter
+        self.register_handler(MsgType.Reply_BatchAdd, self._on_reply)
+
+    def register_handler(self, msg_type, fn):
+        pass
+
+    def _on_reply(self, msg):
+        err = msg.take_error()
+        if err is not None:
+            self._errors = err
+        self._waiter.notify()

@@ -1,0 +1,119 @@
+"""wake-protocol fixture: seeded latch-ordering violations (never
+imported).
+
+Expected findings (tests/test_mvlint.py pins the counts):
+  line A: the pre-PR-19 ordering — the parking loop checks
+          self._stopped BEFORE re-arming the wake latch; a
+          stop() in that window sees the stale True gate,
+          skips its byte, and the loop parks forever       -> violation
+  line B: latch re-armed only AFTER the park               -> violation
+  line C: parking loop never re-arms the latch at all      -> violation
+  line D: pragma'd bad ordering (per-def)                 -> suppressed
+Clean: GoodLoop re-arms first, then checks state, then parks —
+the lexical order runtime/tcp.py's event loop uses.
+"""
+
+import os
+
+
+class BadLoop:
+    """The PR-19 lost-wakeup shape, verbatim."""
+
+    def __init__(self, sel, rfd, wfd):
+        self._sel = sel
+        self._rfd = rfd
+        self._wfd = wfd
+        self._woken = False
+        self._stopped = False
+
+    def wake(self):
+        if self._woken:
+            return
+        self._woken = True
+        os.write(self._wfd, b"\0")
+
+    def _main(self):
+        while True:
+            if self._stopped:
+                return
+            self._woken = False                                     # A
+            self._sel.select(None)
+            os.read(self._rfd, 4096)
+
+
+class LateRearm:
+    def __init__(self, sel):
+        self._sel = sel
+        self._woken = False
+
+    def wake(self):
+        if self._woken:
+            return
+        self._woken = True
+        self._cond.notify_all()
+
+    def _main(self):
+        while True:
+            self._sel.select(None)
+            self._woken = False                                     # B
+
+
+class NeverRearms:
+    def __init__(self, sel, wfd):
+        self._sel = sel
+        self._wfd = wfd
+        self._woken = False
+
+    def wake(self):
+        if self._woken:
+            return
+        self._woken = True
+        os.write(self._wfd, b"\0")
+
+    def _main(self):
+        while True:                                                 # C
+            self._sel.select(None)
+
+
+class PragmaLoop:
+    def __init__(self, sel, wfd):
+        self._sel = sel
+        self._wfd = wfd
+        self._woken = False
+        self._quit = False
+
+    def wake(self):
+        if self._woken:
+            return
+        self._woken = True
+        os.write(self._wfd, b"\0")
+
+    def _main(self):  # mvlint: ignore[wake-protocol]  (D)
+        while True:
+            if self._quit:
+                return
+            self._woken = False
+            self._sel.select(None)
+
+
+class GoodLoop:
+    """Clean: re-arm FIRST, then the state checks, then the park."""
+
+    def __init__(self, sel, wfd):
+        self._sel = sel
+        self._wfd = wfd
+        self._woken = False
+        self._stopped = False
+
+    def wake(self):
+        if self._woken:
+            return
+        self._woken = True
+        os.write(self._wfd, b"\0")
+
+    def _main(self):
+        while True:
+            self._woken = False
+            if self._stopped:
+                return
+            self._sel.select(None)

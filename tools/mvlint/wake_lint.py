@@ -1,0 +1,189 @@
+"""wake-protocol lint (pass 12): the check-flag-then-block idiom that
+produced the PR-19 lost wakeup, enforced in the lexical order the
+event loop now uses.
+
+The hazardous shape is a *wake latch*: a boolean attribute guarding a
+wake side-channel so N wake() calls cost one pipe byte / one notify —
+
+    def wake(self):
+        if self._woken:          # gate: someone already paid the byte
+            return
+        self._woken = True
+        os.write(self._wake_w, b"\\0")
+
+paired with a consumer loop that re-arms the latch (``self._woken =
+False``) and then parks (``select`` / condition ``wait`` /
+``os.read``). The PR-19 bug was pure *ordering*: the loop drained the
+pipe, THEN checked ``self._stopped``, THEN re-armed. A ``stop()``
+landing in the drain→re-arm window saw the stale ``True`` latch,
+skipped its byte, and the loop parked forever on an empty pipe. The
+fix — and the idiom this pass enforces — re-arms FIRST, before any
+state check and before the park: a stale-latch window then never
+overlaps a park, because any wake that set the latch after the last
+drain also left its byte in the pipe.
+
+Detection is lexical, per class, no call graph needed:
+
+* a **latch** is an attribute with the gate shape above (an
+  ``if self.X: return`` guard plus ``self.X = True`` in one method,
+  followed by a wake side-effect call — ``write``/``notify``/
+  ``notify_all``/``set``). The side-effect requirement keeps
+  idempotent-close guards (``if self._closed: return``), which are
+  one-way flags and never re-armed, out of scope.
+* every ``while`` loop in the same class that **parks** (calls
+  ``select``/``wait``/``wait_for``/``os.read``) must re-arm the
+  latch, and the re-arm must lexically precede every ``if`` statement
+  and every park in the loop body.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .framework import LintPass, ModuleInfo, Violation
+
+#: Call names that count as the gate's wake side-effect.
+WAKE_EFFECTS = frozenset({"write", "notify", "notify_all", "set"})
+
+#: Call names that park the calling thread.
+PARK_CALLS = frozenset({"select", "wait", "wait_for"})
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> ``"x"``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_park(call: ast.Call) -> bool:
+    name = _call_name(call)
+    if name in PARK_CALLS:
+        return True
+    # os.read(fd, n): the raw self-pipe drain.
+    return name == "read" and isinstance(call.func, ast.Attribute) and \
+        isinstance(call.func.value, ast.Name) and \
+        call.func.value.id == "os"
+
+
+def _walk_no_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """Recursive walk that does not descend into nested defs/lambdas
+    (their bodies run on their own schedule, not in this loop)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_no_defs(child)
+
+
+class WakeProtocolLint(LintPass):
+    name = "wake-protocol"
+
+    # -- latch discovery ---------------------------------------------
+    def _gate_latches(self, cls: ast.ClassDef) -> Dict[str, int]:
+        """Attr name -> gate line, for every wake-latch gate in the
+        class: ``if self.X: return`` + ``self.X = True`` + a wake
+        side-effect call after the set, all in one method."""
+        latches: Dict[str, int] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            guards: Dict[str, int] = {}
+            sets: Dict[str, int] = {}
+            effects: List[int] = []
+            for node in _walk_no_defs(item):
+                if isinstance(node, ast.If):
+                    attr = _self_attr(node.test)
+                    if attr is not None and any(
+                            isinstance(s, ast.Return)
+                            for s in node.body):
+                        guards.setdefault(attr, node.lineno)
+                elif isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1:
+                    attr = _self_attr(node.targets[0])
+                    if attr is not None and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value is True:
+                        sets.setdefault(attr, node.lineno)
+                elif isinstance(node, ast.Call) and \
+                        _call_name(node) in WAKE_EFFECTS:
+                    effects.append(node.lineno)
+            for attr, gline in guards.items():
+                sline = sets.get(attr)
+                if sline is None:
+                    continue
+                if any(e >= sline for e in effects):
+                    latches.setdefault(attr, gline)
+        return latches
+
+    # -- loop checks -------------------------------------------------
+    def _check_loop(self, rel: str, cls_name: str, latch: str,
+                    loop: ast.While) -> Iterator[Violation]:
+        parks: List[int] = []
+        rearms: List[int] = []
+        checks: List[int] = []
+        for node in _walk_no_defs(loop):
+            if isinstance(node, ast.Call) and _is_park(node):
+                parks.append(node.lineno)
+            elif isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    _self_attr(node.targets[0]) == latch and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is False:
+                rearms.append(node.lineno)
+            elif isinstance(node, ast.If):
+                checks.append(node.lineno)
+        if not parks:
+            return
+        if not rearms:
+            yield Violation(
+                rel, loop.lineno, loop.col_offset, self.name,
+                f"{cls_name}: loop parks (select/wait/os.read) but "
+                f"never re-arms wake latch self.{latch} — after the "
+                f"first wake the gate stays True, every later wake "
+                f"is skipped, and the park never returns")
+            return
+        rearm = min(rearms)
+        bad_park = min(parks) < rearm
+        bad_check = any(c < rearm for c in checks)
+        if bad_park or bad_check:
+            what = "the park" if bad_park and not bad_check else (
+                "a state check" if bad_check and not bad_park
+                else "a state check and the park")
+            yield Violation(
+                rel, rearm, 0, self.name,
+                f"{cls_name}: wake latch self.{latch} is re-armed "
+                f"AFTER {what} in the parking loop — a wake landing "
+                f"in the drain-to-re-arm window sees the stale True "
+                f"gate, skips its wake byte, and the next park "
+                f"blocks forever (the PR-19 lost-wakeup shape); "
+                f"re-arm first, then check state, then park")
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            latches = self._gate_latches(node)
+            if not latches:
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for sub in _walk_no_defs(item):
+                    if isinstance(sub, ast.While):
+                        for latch in sorted(latches):
+                            yield from self._check_loop(
+                                module.rel, node.name, latch, sub)
